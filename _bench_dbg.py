@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Driver benchmark: ResNet-50 training throughput (BASELINE.json config 1).
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+Runs the compiled TrainStep path (one XLA program per step) on whatever device jax
+exposes (real TPU chip under the driver; CPU elsewhere).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.jit.train import TrainStep
+
+    on_accel = jax.devices()[0].platform not in ("cpu",)
+    batch = 64 if on_accel else 4
+    img = 224 if on_accel else 64
+    steps = 20 if on_accel else 3
+
+    paddle.seed(0)
+    model = paddle.vision.models.resnet50(num_classes=1000)
+    if on_accel:
+        # bf16 params + activations: the TPU-native precision for conv/matmul
+        paddle.amp.decorate(model, level="O2", dtype="bfloat16")
+    loss_fn = nn.CrossEntropyLoss()
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=model.parameters(),
+                                    multi_precision=on_accel)
+    step = TrainStep(model, lambda out, y: loss_fn(out, y), opt)
+
+    x = paddle.to_tensor(
+        np.random.randn(batch, 3, img, img).astype("bfloat16" if on_accel else "float32")
+    )
+    y = paddle.to_tensor(np.random.randint(0, 1000, batch).astype("int64"))
+
+    # warmup / compile
+    step(x, y)._value.block_until_ready()
+    step(x, y)._value.block_until_ready()
+    # block every step: the loss of step i does not depend on step i's own param
+    # update, so blocking only on the final loss lets XLA's async dispatch hide real
+    # work and overstates throughput
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        _t=time.perf_counter();loss = step(x, y)
+        loss._value.block_until_ready();print(f"{(time.perf_counter()-_t)*1000:.1f}ms")
+    dt = time.perf_counter() - t0
+    ips = batch * steps / dt
+
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec" if on_accel
+        else "resnet50_train_images_per_sec_cpu_smoke",
+        "value": round(ips, 2),
+        "unit": "images/sec",
+        "vs_baseline": None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

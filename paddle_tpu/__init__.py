@@ -1,0 +1,81 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle's capability set.
+
+Built from scratch on jax/XLA/Pallas: eager tensors over jax arrays, tape autograd via
+jax.vjp, trace-and-compile jit, GSPMD-based distributed training over a named device
+mesh. See SURVEY.md for the reference (lifulll/Paddle) layer map this targets.
+"""
+from __future__ import annotations
+
+import jax as _jax
+
+# int64/float64 must exist for paddle dtype parity (default int dtype is int64 in the
+# reference). Creation ops still default floats to float32 (TPU-native).
+_jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+from .framework import dtype as _dtype_mod  # noqa: E402
+from .framework.dtype import (  # noqa: E402,F401
+    bfloat16, bool_, complex64, complex128, float16, float32, float64, int8, int16,
+    int32, int64, uint8, get_default_dtype, set_default_dtype, finfo, iinfo,
+)
+
+bool = bool_  # paddle.bool
+
+from .framework.device import (  # noqa: E402,F401
+    CPUPlace, CUDAPlace, Place, TPUPlace, XPUPlace, get_device, set_device,
+    is_compiled_with_cuda, is_compiled_with_xpu,
+)
+from .framework.random import seed, get_rng_state, set_rng_state  # noqa: E402,F401
+from .tensor import Tensor, to_tensor  # noqa: E402,F401
+from .autograd import no_grad, enable_grad, grad, is_grad_enabled, set_grad_enabled  # noqa: E402,F401
+from .autograd.tape import set_grad_enabled_ctx  # noqa: E402
+
+from . import ops  # noqa: E402
+from .ops import *  # noqa: E402,F401,F403
+
+from . import nn  # noqa: E402
+from . import optimizer  # noqa: E402
+from . import io  # noqa: E402
+from . import amp  # noqa: E402
+from . import jit  # noqa: E402
+from . import vision  # noqa: E402
+from . import metric  # noqa: E402
+from . import distributed  # noqa: E402
+from . import autograd  # noqa: E402
+from . import framework  # noqa: E402
+from . import linalg  # noqa: E402
+from . import device  # noqa: E402
+from . import incubate  # noqa: E402
+from . import distribution  # noqa: E402
+from .framework.io_utils import save, load  # noqa: E402,F401
+from .hapi.model import Model  # noqa: E402,F401
+from .nn.layer import ParamAttr  # noqa: E402,F401
+
+# DataParallel lives at paddle.DataParallel in the reference
+from .distributed.parallel import DataParallel  # noqa: E402,F401
+
+
+def is_grad_enabled_():
+    return is_grad_enabled()
+
+
+def disable_static(place=None):
+    """Dygraph is the only mode; kept for API compat."""
+    return None
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu is define-by-run + jit tracing only; use paddle_tpu.jit.to_static"
+    )
+
+
+def in_dynamic_mode():
+    return True
+
+
+def device_count():
+    from .framework import device as _d
+
+    return _d.device_count()

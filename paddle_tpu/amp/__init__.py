@@ -1,0 +1,195 @@
+"""AMP: auto_cast + GradScaler. Reference: python/paddle/amp/ (auto_cast.py:104,650-658
+master weights; grad_scaler.py).
+
+TPU-native: bf16 is the native half type — O1/O2 cast to bfloat16 by default and
+GradScaler becomes a no-op passthrough (bf16 needs no loss scaling; fp16 path keeps
+dynamic scaling for parity)."""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as _dt
+from ..tensor import Tensor
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler", "is_auto_cast_enabled",
+           "get_amp_dtype", "white_list", "black_list"]
+
+_amp_state = {"enable": False, "dtype": _dt.bfloat16, "level": "O1",
+              "custom_white_list": set(), "custom_black_list": set()}
+
+# Reference amp_lists.py: ops that are numerically safe in low precision (matmul-family)
+# vs ops that must stay fp32 (softmax/norm/exp family).
+WHITE_LIST = {"matmul", "mm", "bmm", "linear", "conv1d", "conv2d", "conv3d", "einsum"}
+BLACK_LIST = {"exp", "square", "log", "mean", "sum", "cos_sim", "softmax",
+              "softmax_with_cross_entropy", "sigmoid_cross_entropy_with_logits",
+              "cross_entropy", "layer_norm", "batch_norm"}
+
+
+def white_list():
+    return WHITE_LIST | _amp_state["custom_white_list"]
+
+
+def black_list():
+    return BLACK_LIST | _amp_state["custom_black_list"]
+
+
+def is_auto_cast_enabled():
+    return _amp_state["enable"]
+
+
+def get_amp_dtype():
+    return _amp_state["dtype"]
+
+
+def get_amp_level():
+    return _amp_state["level"]
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None, level="O1",
+              dtype="bfloat16", use_promote=True):
+    prev = dict(_amp_state)
+    _amp_state.update(
+        enable=enable,
+        dtype=_dt.convert_dtype(dtype),
+        level=level,
+        custom_white_list=set(custom_white_list or ()),
+        custom_black_list=set(custom_black_list or ()),
+    )
+    try:
+        yield
+    finally:
+        _amp_state.update(prev)
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16", master_weight=None,
+             save_dtype=None, master_grad=False, excluded_layers=None):
+    """O2: cast model params to low precision; optimizer keeps fp32 master weights
+    (multi_precision)."""
+    d = _dt.convert_dtype(dtype)
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        from ..nn.layer_conv_norm import _BatchNormBase, LayerNorm
+
+        excluded = (_BatchNormBase, LayerNorm)
+        for m in model_list:
+            for layer in m.sublayers(include_self=True):
+                if isinstance(layer, excluded):
+                    continue
+                for p in layer._parameters.values():
+                    if p is not None and jnp.issubdtype(p.dtype, jnp.floating):
+                        p._value = p._value.astype(d)
+        if optimizers is not None:
+            opt_list = optimizers if isinstance(optimizers, (list, tuple)) else [optimizers]
+            for o in opt_list:
+                o._multi_precision = True if master_weight is not False else False
+    if optimizers is None:
+        return models if single_model else model_list
+    return (models if single_model else model_list), optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (reference: python/paddle/amp/grad_scaler.py). For bf16 this
+    is an identity; fp16 keeps the scale/unscale/found-inf logic."""
+
+    def __init__(self, enable=True, init_loss_scaling=65536.0, incr_ratio=2.0,
+                 decr_ratio=0.5, incr_every_n_steps=2000, decr_every_n_nan_or_inf=1,
+                 use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for _, p in optimizer._parameters_list():
+            if p._grad is not None:
+                g = p._grad * inv
+                p._grad = g
+                found = found or bool(jnp.any(~jnp.isfinite(g)))
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio, "incr_count": self._good_steps,
+                "decr_count": self._bad_steps}
+
+    def load_state_dict(self, state):
+        self._scale = state.get("scale", self._scale)
+        self._good_steps = state.get("incr_count", 0)
+        self._bad_steps = state.get("decr_count", 0)
+
+
+# debugging helpers (reference python/paddle/amp/debugging.py)
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+    v = tensor._value if isinstance(tensor, Tensor) else tensor
+    n_nan = int(jnp.sum(jnp.isnan(v)))
+    n_inf = int(jnp.sum(jnp.isinf(v)))
+    if n_nan or n_inf:
+        raise FloatingPointError(
+            f"check_numerics failed for {op_type}:{var_name}: {n_nan} NaN, {n_inf} Inf"
+        )
+    return n_nan, n_inf

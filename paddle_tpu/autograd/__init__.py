@@ -1,0 +1,30 @@
+"""Autograd public API. Reference: python/paddle/autograd/."""
+from . import tape  # noqa: F401
+from .tape import (  # noqa: F401
+    backward,
+    enable_grad,
+    grad,
+    is_grad_enabled,
+    no_grad,
+    set_grad_enabled,
+)
+
+def __getattr__(name):
+    # PyLayer imports Tensor which imports this package: resolve lazily.
+    if name in ("PyLayer", "PyLayerContext"):
+        from . import py_layer
+
+        return getattr(py_layer, name)
+    raise AttributeError(name)
+
+
+__all__ = [
+    "backward",
+    "grad",
+    "no_grad",
+    "enable_grad",
+    "is_grad_enabled",
+    "set_grad_enabled",
+    "PyLayer",
+    "PyLayerContext",
+]

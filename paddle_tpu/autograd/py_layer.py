@@ -1,0 +1,79 @@
+"""PyLayer: user-defined forward/backward. Reference: python/paddle/autograd/py_layer.py.
+
+The reference uses PyLayer pervasively in distributed code (ScatterOp/GatherOp etc.). Here
+a PyLayer's backward is spliced into the tape as a custom Node whose "vjp" calls the
+user's backward with wrapped Tensors.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from . import tape
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = []
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    def saved_tensor(self):
+        return list(self._saved)
+
+    # paddle alias
+    saved_tensors = property(lambda self: list(self._saved))
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, attrs):
+        super().__init__(name, bases, attrs)
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with tape.no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outputs, (tuple, list))
+        out_list = [outputs] if single else list(outputs)
+        out_tensors = [o for o in out_list if isinstance(o, Tensor)]
+
+        diff_inputs = [
+            a for a in args if isinstance(a, Tensor) and not a.stop_gradient
+        ]
+        if tape.is_grad_enabled() and diff_inputs:
+
+            def vjp_fn(cotangents):
+                grads_in = [
+                    Tensor(c, stop_gradient=True) if c is not None else None
+                    for c in cotangents
+                ]
+                with tape.no_grad():
+                    result = cls.backward(ctx, *grads_in)
+                if not isinstance(result, (tuple, list)):
+                    result = (result,)
+                # map returned grads (one per differentiable tensor input, paddle contract
+                # is one per tensor input in order) onto diff_inputs
+                flat = [r._value if isinstance(r, Tensor) else r for r in result]
+                # If the user returned grads for all tensor args, filter to diff ones.
+                tensor_args = [a for a in args if isinstance(a, Tensor)]
+                if len(flat) == len(tensor_args) != len(diff_inputs):
+                    flat = [
+                        g for a, g in zip(tensor_args, flat) if not a.stop_gradient
+                    ]
+                return tuple(flat)
+
+            tape.record(vjp_fn, diff_inputs, out_tensors, name=cls.__name__)
+        return outputs

@@ -1,0 +1,323 @@
+"""Define-by-run autograd as a tape over `jax.vjp`.
+
+Reference parity: the eager GradNode graph + `egr::Backward()` engine
+(paddle/fluid/eager/grad_node_info.h, backward.h:26 in the reference). TPU-native design:
+instead of per-op hand-written grad kernels, every recorded op captures a `jax.vjp` closure
+— forward AND the pullback are built in one pass, both are jax-traceable, so the same tape
+works eagerly on device and under `jit` tracing (where the residuals are tracers and the
+whole backward fuses into the compiled program).
+
+The tape is implicit: each produced Tensor holds a reference to the Node that made it;
+`backward(root)` runs a topological sweep with per-node pending-dependency counts, exactly
+the queue discipline of the reference's Backward() engine.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+# ---------------------------------------------------------------------------- grad mode
+
+_grad_enabled = True
+
+
+def is_grad_enabled() -> bool:
+    return _grad_enabled
+
+
+def set_grad_enabled(mode: bool):
+    global _grad_enabled
+    _grad_enabled = bool(mode)
+
+
+class no_grad(contextlib.ContextDecorator):
+    """paddle.no_grad — usable as context manager and decorator."""
+
+    def __enter__(self):
+        global _grad_enabled
+        self._prev = _grad_enabled
+        _grad_enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        global _grad_enabled
+        _grad_enabled = self._prev
+        return False
+
+
+class enable_grad(contextlib.ContextDecorator):
+    def __enter__(self):
+        global _grad_enabled
+        self._prev = _grad_enabled
+        _grad_enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        global _grad_enabled
+        _grad_enabled = self._prev
+        return False
+
+
+@contextlib.contextmanager
+def set_grad_enabled_ctx(mode: bool):
+    global _grad_enabled
+    prev = _grad_enabled
+    _grad_enabled = bool(mode)
+    try:
+        yield
+    finally:
+        _grad_enabled = prev
+
+
+# ---------------------------------------------------------------------------- tape nodes
+
+
+class Node:
+    """One recorded op: inputs (diff positions only), a vjp closure, #outputs."""
+
+    __slots__ = (
+        "vjp_fn",
+        "inputs",
+        "n_outputs",
+        "name",
+        "out_grads",
+        "out_avals",
+        "pending",
+        "_hooks",
+    )
+
+    def __init__(
+        self,
+        vjp_fn: Callable,
+        inputs: Sequence[Any],
+        n_outputs: int,
+        name: str,
+        out_avals: Sequence[Any] | None = None,
+    ):
+        self.vjp_fn = vjp_fn
+        self.inputs = list(inputs)  # Tensors (the differentiable inputs, in vjp order)
+        self.n_outputs = n_outputs
+        self.name = name
+        self.out_grads: list[Any] = [None] * n_outputs
+        self.out_avals = list(out_avals) if out_avals is not None else [None] * n_outputs
+        self.pending = 0  # filled during backward topo pass
+        self._hooks: list[Callable] | None = None
+
+    def add_hook(self, hook: Callable):
+        if self._hooks is None:
+            self._hooks = []
+        self._hooks.append(hook)
+
+    def release(self):
+        """Drop residuals so memory is freed once the node has run."""
+        self.vjp_fn = None
+        self.out_grads = [None] * self.n_outputs
+
+
+def record(vjp_fn, input_tensors, outputs, name="op"):
+    """Attach a Node to each output tensor. `outputs` is a list of Tensors."""
+    node = Node(
+        vjp_fn,
+        input_tensors,
+        len(outputs),
+        name,
+        out_avals=[(o.value.shape, o.value.dtype) for o in outputs],
+    )
+    for i, out in enumerate(outputs):
+        out._grad_node = node
+        out._grad_index = i
+        out.stop_gradient = False
+    return node
+
+
+# ---------------------------------------------------------------------------- backward
+
+
+def _accumulate(a, b):
+    if a is None:
+        return b
+    return a + b
+
+
+def _zero_cotangent(aval):
+    """Zero cotangent for an unused output. Integer/bool outputs (argmax indices, masks)
+    take jax's float0 tangent type, matching jax.vjp's contract."""
+    shape, dtype = aval
+    if jnp.issubdtype(dtype, jnp.floating) or jnp.issubdtype(dtype, jnp.complexfloating):
+        return jnp.zeros(shape, dtype)
+    import numpy as _np
+
+    return _np.zeros(shape, jax.dtypes.float0)
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False, capture=None):
+    """paddle.autograd.backward / Tensor.backward.
+
+    Topological sweep: count in-degrees (how many downstream nodes feed each node's
+    outputs), then process nodes whose output grads are fully accumulated — mirroring the
+    reference's queue-based engine (paddle/fluid/eager/backward.cc).
+
+    `capture`: optional dict {id(tensor): None} — gradients flowing INTO these tensors
+    (leaf or intermediate) are also accumulated into the dict; used by paddle.grad to
+    harvest grads w.r.t. non-leaf tensors without touching .grad.
+    """
+    from ..tensor import Tensor
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+
+    # Seed gradients.
+    roots = []
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient:
+            continue
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; tensor has "
+                    f"shape {t.shape}"
+                )
+            seed_val = jnp.ones_like(t.value)
+        else:
+            seed_val = g.value if isinstance(g, Tensor) else jnp.asarray(g)
+        roots.append((t, seed_val))
+
+    # Discover the reachable graph; count pending outputs per node.
+    nodes: dict[int, Node] = {}
+    order: list[Node] = []
+
+    def visit(node: Node):
+        if node is None or id(node) in nodes:
+            return
+        nodes[id(node)] = node
+        node.pending = 0
+        for inp in node.inputs:
+            visit(inp._grad_node)
+        order.append(node)
+
+    for t, _ in roots:
+        visit(t._grad_node)
+
+    # pending = number of downstream consumers (nodes that will contribute grads to me).
+    consumers: dict[int, int] = {id(n): 0 for n in order}
+    for n in order:
+        for inp in n.inputs:
+            gn = inp._grad_node
+            if gn is not None:
+                consumers[id(gn)] += 1
+
+    # Seed root node output grads / leaf grads.
+    ready: list[Node] = []
+    for t, seed_val in roots:
+        if capture is not None and id(t) in capture:
+            capture[id(t)] = _accumulate(capture[id(t)], seed_val)
+        node = t._grad_node
+        if node is None:
+            if capture is None or id(t) not in capture:
+                t._accumulate_grad(seed_val)
+            continue
+        idx = t._grad_index
+        node.out_grads[idx] = _accumulate(node.out_grads[idx], seed_val)
+
+    done: set[int] = set()
+
+    def maybe_ready(n: Node):
+        if id(n) in done:
+            return
+        if consumers[id(n)] == 0:
+            ready.append(n)
+            done.add(id(n))
+
+    for n in order:
+        maybe_ready(n)
+
+    processed = 0
+    while ready:
+        node = ready.pop()
+        processed += 1
+        cotangents = tuple(
+            g if g is not None else _zero_cotangent(aval)
+            for g, aval in zip(node.out_grads, node.out_avals)
+        )
+        # jax.vjp closures take the output cotangent structure: single value if one
+        # output, tuple otherwise (we always recorded the fn returning a tuple).
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                "Trying to backward through the graph a second time, but the saved "
+                "intermediate results have already been freed. Specify retain_graph=True."
+            )
+        in_grads = node.vjp_fn(cotangents)
+        if node._hooks:
+            in_grads = list(in_grads)
+            for hook in node._hooks:
+                in_grads = [hook(g) if g is not None else None for g in in_grads]
+        for inp, g in zip(node.inputs, in_grads):
+            if g is None:
+                continue
+            if capture is not None and id(inp) in capture:
+                capture[id(inp)] = _accumulate(capture[id(inp)], g)
+            gn = inp._grad_node
+            if gn is None:
+                # leaf (or detached intermediate): accumulate into .grad
+                if not inp.stop_gradient and (capture is None or id(inp) not in capture):
+                    inp._accumulate_grad(g)
+            else:
+                gn.out_grads[inp._grad_index] = _accumulate(
+                    gn.out_grads[inp._grad_index], g
+                )
+                consumers[id(gn)] -= 1
+                maybe_ready(gn)
+        if not retain_graph:
+            node.release()
+        else:
+            node.out_grads = [None] * node.n_outputs
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph=False,
+    allow_unused=False,
+):
+    """paddle.grad — functional gradient w.r.t. `inputs` (leaf OR intermediate tensors)
+    without touching .grad fields. Grads are harvested via the backward sweep's capture
+    dict, so non-leaf inputs receive the cotangent flowing into them."""
+    from ..tensor import Tensor
+
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+
+    prev_sg = [t.stop_gradient for t in inputs]
+    for t in inputs:
+        t.stop_gradient = False
+    capture = {id(t): None for t in inputs}
+    try:
+        backward(outputs, grad_tensors=grad_outputs,
+                 retain_graph=bool(retain_graph) or create_graph, capture=capture)
+        results = []
+        for t in inputs:
+            g = capture[id(t)]
+            if g is None:
+                if not allow_unused:
+                    raise RuntimeError(
+                        f"gradient of input {t.name} is None (not reachable from "
+                        "outputs); pass allow_unused=True to return None instead"
+                    )
+                results.append(None)
+            else:
+                results.append(Tensor(g, stop_gradient=not create_graph))
+        return results
+    finally:
+        for t, sg in zip(inputs, prev_sg):
+            t.stop_gradient = sg

@@ -1,0 +1,144 @@
+"""paddle.device namespace. Reference: python/paddle/device/."""
+from __future__ import annotations
+
+import jax
+
+from ..framework.device import (  # noqa: F401
+    CPUPlace, CUDAPlace, Place, TPUPlace, XPUPlace, device_count, get_device, get_place,
+    is_compiled_with_cuda, is_compiled_with_tpu, is_compiled_with_xpu, set_device,
+)
+
+__all__ = ["set_device", "get_device", "get_all_device_type", "get_all_custom_device_type",
+           "get_available_device", "get_available_custom_device", "device_count",
+           "synchronize", "cuda", "Stream", "Event", "stream_guard", "current_stream"]
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_all_custom_device_type():
+    return [p for p in get_all_device_type() if p not in ("cpu", "gpu")]
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices() if d.platform not in ("cpu", "gpu")]
+
+
+def synchronize(device=None):
+    """Block until all queued device work completes (XLA is async by default)."""
+    jax.effects_barrier() if hasattr(jax, "effects_barrier") else None
+    import jax.numpy as jnp
+
+    jnp.zeros(()).block_until_ready()
+
+
+class Stream:
+    """XLA schedules its own streams; this exists for API parity and ordering is a no-op
+    (all work on one device is program-ordered)."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+    def query(self):
+        return True
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+_current_stream = Stream()
+
+
+def current_stream(device=None):
+    return _current_stream
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def stream_guard(stream):
+    yield
+
+
+class cuda:
+    """paddle.device.cuda compat shim — maps to the accelerator device."""
+
+    Stream = Stream
+    Event = Event
+
+    @staticmethod
+    def device_count():
+        return device_count()
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize()
+
+    @staticmethod
+    def current_stream(device=None):
+        return _current_stream
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        try:
+            stats = jax.devices()[0].memory_stats()
+            return stats.get("peak_bytes_in_use", 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def memory_allocated(device=None):
+        try:
+            stats = jax.devices()[0].memory_stats()
+            return stats.get("bytes_in_use", 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def max_memory_reserved(device=None):
+        try:
+            stats = jax.devices()[0].memory_stats()
+            return stats.get("peak_bytes_in_use", 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def memory_reserved(device=None):
+        try:
+            stats = jax.devices()[0].memory_stats()
+            return stats.get("bytes_limit", 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def empty_cache():
+        pass

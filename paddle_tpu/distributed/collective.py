@@ -1,0 +1,247 @@
+"""Communication API. Reference: python/paddle/distributed/communication/ (4K LoC:
+all_reduce/all_gather/all_to_all/broadcast/reduce_scatter/send/recv/...).
+
+TPU-native contract (SURVEY.md §5): inside a traced/shard_map region these lower to
+`jax.lax` collectives over named mesh axes; outside a trace on a single process they are
+executed eagerly over the sharded global array (XLA inserts the ICI collective when the
+array spans devices). The `group` argument maps to a mesh axis name.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor
+from . import env
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A communication group = a mesh axis (or the world)."""
+
+    _gid = 0
+
+    def __init__(self, ranks=None, axis_name=None, mesh=None):
+        Group._gid += 1
+        self.id = Group._gid
+        self.ranks = ranks if ranks is not None else list(range(env.get_world_size()))
+        self.axis_name = axis_name
+        self.mesh = mesh
+
+    @property
+    def nranks(self):
+        return len(self.ranks)
+
+    @property
+    def rank(self):
+        r = env.get_rank()
+        return self.ranks.index(r) if r in self.ranks else -1
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+
+_default_group: Group | None = None
+
+
+def _get_group(group):
+    global _default_group
+    if group is not None:
+        return group
+    if _default_group is None:
+        _default_group = Group()
+    return _default_group
+
+
+def new_group(ranks=None, backend=None, timeout=None):
+    return Group(ranks)
+
+
+def get_group(gid=0):
+    return _get_group(None)
+
+
+def destroy_process_group(group=None):
+    global _default_group
+    _default_group = None
+
+
+def is_available():
+    return True
+
+
+def _in_trace(v):
+    return isinstance(v, jax.core.Tracer)
+
+
+def _axis(group):
+    g = _get_group(group)
+    return g.axis_name
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """In-place all-reduce (paddle semantics: mutates `tensor`)."""
+    v = tensor._value
+    ax = _axis(group)
+    if _in_trace(v) and ax is not None:
+        fns = {"sum": jax.lax.psum, "max": jax.lax.pmax, "min": jax.lax.pmin,
+               "avg": jax.lax.pmean}
+        tensor._value = fns[op if isinstance(op, str) else "sum"](v, ax)
+        return tensor
+    # eager single-process world: identity (world size 1 per process under TPU SPMD)
+    return tensor
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    v = tensor._value
+    ax = _axis(group)
+    if _in_trace(v) and ax is not None:
+        gathered = jax.lax.all_gather(v, ax)
+        n = gathered.shape[0]
+        for i in range(n):
+            tensor_list.append(Tensor(gathered[i]))
+        return tensor_list
+    tensor_list.append(Tensor(v))
+    return tensor_list
+
+
+def all_gather_object(object_list, obj, group=None):
+    object_list.append(obj)
+    return object_list
+
+
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=True):
+    vs = [t._value for t in tensor_list] if isinstance(tensor_list, (list, tuple)) else [
+        tensor_list._value
+    ]
+    ax = _axis(group)
+    if _in_trace(vs[0]) and ax is not None:
+        stacked = jnp.stack(vs) if len(vs) > 1 else vs[0]
+        out = jax.lax.psum_scatter(stacked, ax, scatter_dimension=0, tiled=len(vs) == 1)
+        tensor._value = out
+        return tensor
+    tensor._value = vs[0] if len(vs) == 1 else sum(vs)
+    return tensor
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    return tensor
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    return object_list
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if tensor_list:
+        g = _get_group(group)
+        idx = g.rank if g.rank >= 0 else 0
+        tensor._value = tensor_list[idx]._value
+    return tensor
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    if gather_list is not None:
+        gather_list.append(Tensor(tensor._value))
+    return gather_list
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    ax = _axis(group)
+    vs = [t._value for t in in_tensor_list]
+    if vs and _in_trace(vs[0]) and ax is not None:
+        stacked = jnp.stack(vs)
+        out = jax.lax.all_to_all(stacked, ax, split_axis=0, concat_axis=0, tiled=False)
+        for i in range(out.shape[0]):
+            out_tensor_list.append(Tensor(out[i]))
+        return out_tensor_list
+    out_tensor_list.extend(Tensor(v) for v in vs)
+    return out_tensor_list
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None, out_split_sizes=None,
+                    group=None, sync_op=True):
+    v = in_tensor._value
+    ax = _axis(group)
+    if _in_trace(v) and ax is not None:
+        g = _get_group(group)
+        n = g.nranks
+        resh = v.reshape((n, v.shape[0] // n) + v.shape[1:])
+        out = jax.lax.all_to_all(resh, ax, split_axis=0, concat_axis=0, tiled=False)
+        out_tensor._value = out.reshape(v.shape)
+        return out_tensor
+    out_tensor._value = v
+    return out_tensor
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    _p2p_buffer.setdefault(dst, []).append(np.asarray(tensor._value))
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    buf = _p2p_buffer.get(env.get_rank(), [])
+    if buf:
+        tensor._value = jnp.asarray(buf.pop(0))
+    return tensor
+
+
+_p2p_buffer: dict = {}
+
+
+def isend(tensor, dst=0, group=None):
+    send(tensor, dst, group)
+    return _Work()
+
+
+def irecv(tensor, src=0, group=None):
+    recv(tensor, src, group)
+    return _Work()
+
+
+class _Work:
+    def wait(self):
+        return True
+
+    def is_completed(self):
+        return True
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    reqs = []
+    for op in p2p_op_list:
+        reqs.append(op.op(op.tensor, op.peer, op.group))
+    return reqs
+
+
+def barrier(group=None):
+    jnp.zeros(()).block_until_ready()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if not _in_trace(tensor._value):
+        tensor._value.block_until_ready()
+    return tensor

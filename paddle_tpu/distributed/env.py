@@ -1,0 +1,79 @@
+"""Distributed environment. Reference: python/paddle/distributed/parallel.py
+(init_parallel_env:978, ParallelEnv).
+
+TPU-native: one Python process per host, all devices visible; "rank" maps to
+jax.process_index() for multi-host and to 0 on single host. The reference's
+TCPStore/env-var bootstrap is replaced by jax.distributed.initialize (the coordinator).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+_initialized = False
+
+
+def init_parallel_env(coordinator_address=None, num_processes=None, process_id=None):
+    """Reference: parallel.py:978. On a TPU pod-slice each host calls this; under a
+    single host it is a no-op (world = local devices)."""
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    addr = coordinator_address or os.environ.get("MASTER_ADDR")
+    if addr and os.environ.get("MASTER_PORT"):
+        addr = f"{addr}:{os.environ['MASTER_PORT']}"
+    nproc = num_processes or int(os.environ.get("PADDLE_TRAINERS_NUM", "0")) or None
+    pid = process_id if process_id is not None else (
+        int(os.environ["PADDLE_TRAINER_ID"]) if "PADDLE_TRAINER_ID" in os.environ else None
+    )
+    if addr and nproc and nproc > 1:
+        jax.distributed.initialize(addr, num_processes=nproc, process_id=pid)
+    _initialized = True
+    return ParallelEnv()
+
+
+def is_initialized():
+    return _initialized
+
+
+def get_rank(group=None):
+    if group is not None:
+        return group.rank
+    return jax.process_index()
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    return jax.process_count()
+
+
+class ParallelEnv:
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def local_rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:6170")
+
+    @property
+    def trainer_endpoints(self):
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "127.0.0.1:6170").split(",")

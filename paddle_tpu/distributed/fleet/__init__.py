@@ -1,0 +1,35 @@
+"""Fleet: manual hybrid-parallel orchestration. Reference:
+python/paddle/distributed/fleet/fleet.py:218 (init), model.py:33 (distributed_model),
+base/topology.py:189 (HybridCommunicateGroup), base/distributed_strategy.py.
+
+TPU-native: fleet.init builds ONE named mesh ('pp','dp','sharding','mp','sep') from the
+DistributedStrategy degrees (the reference's HybridCommunicateGroup axis order,
+topology.py:199) and the per-strategy wrappers become sharding recipes over that mesh.
+"""
+from __future__ import annotations
+
+from .base import DistributedStrategy, HybridCommunicateGroup, PaddleCloudRoleMaker
+from .fleet_api import (
+    fleet_obj as _fleet,
+    init,
+    distributed_model,
+    distributed_optimizer,
+    get_hybrid_communicate_group,
+)
+from . import meta_parallel  # noqa: F401
+from .meta_parallel import (  # noqa: F401
+    ColumnParallelLinear, PipelineLayer, RowParallelLinear, TensorParallel,
+    VocabParallelEmbedding, LayerDesc, SharedLayerDesc, ParallelCrossEntropy,
+)
+from .recompute import recompute, recompute_sequential  # noqa: F401
+
+worker_num = lambda: _fleet.worker_num()
+worker_index = lambda: _fleet.worker_index()
+is_first_worker = lambda: _fleet.worker_index() == 0
+barrier_worker = lambda: None
+
+
+def get_rank():
+    from .. import env
+
+    return env.get_rank()

@@ -1,0 +1,215 @@
+"""Fleet base: DistributedStrategy + HybridCommunicateGroup + RoleMaker.
+
+Reference: fleet/base/distributed_strategy.py (proto-backed config,
+framework/distributed_strategy.proto:365), fleet/base/topology.py:189-290.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import env
+from ..collective import Group
+from ..mesh import ProcessMesh, set_mesh
+
+
+class DistributedStrategy:
+    """Typed config tree mirroring the proto fields the TPU build honors."""
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1,
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sharding_degree": 1,
+            "sep_degree": 1,
+        }
+        self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1}
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {}
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1
+        self.without_graph_optimization = True
+
+    def __repr__(self):
+        return f"DistributedStrategy(hybrid={self.hybrid_configs})"
+
+
+class CommunicateTopology:
+    """Reference: topology.py CommunicateTopology — axis-ordered hybrid topology."""
+
+    def __init__(self, hybrid_group_names, dims):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = {}
+        self._world = int(np.prod(dims))
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, name):
+        return self._dims[self._parallel_names.index(name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world
+
+    def get_rank_coordinate(self, rank):
+        return list(np.unravel_index(rank, self._dims))
+
+    def get_coord(self, rank):
+        coords = self.get_rank_coordinate(rank)
+        import collections
+
+        C = collections.namedtuple("Coord", self._parallel_names)
+        return C(*coords)
+
+
+class HybridCommunicateGroup:
+    """Reference: topology.py:189. Axis order is [pp, dp, sharding, mp, sep] (reversed
+    vs construction, matching the reference's _HYBRID_PARALLEL_GROUP ordering). On TPU
+    each axis group is a mesh axis; check/fused groups are axis tuples."""
+
+    AXES = ["pp", "dp", "sharding", "sep", "mp"]
+
+    def __init__(self, topology: CommunicateTopology | None = None, strategy=None):
+        if topology is None:
+            cfg = (strategy or DistributedStrategy()).hybrid_configs
+            dims = [cfg["pp_degree"], cfg["dp_degree"], cfg["sharding_degree"],
+                    cfg["sep_degree"], cfg["mp_degree"]]
+            topology = CommunicateTopology(self.AXES, dims)
+        self._topo = topology
+        self.nranks = topology.world_size()
+        self.global_rank = env.get_rank() if env.get_world_size() > 1 else 0
+        dims = [topology.get_dim(a) for a in self.AXES]
+        ids = np.arange(int(np.prod(dims))).reshape(dims)
+        try:
+            self.mesh = ProcessMesh(ids, self.AXES)
+            set_mesh(self.mesh)
+        except ValueError:
+            # more mesh slots than devices: keep logical topology without a jax mesh
+            # (used by schedule unit tests on 1 device)
+            self.mesh = None
+        coord = topology.get_rank_coordinate(self.global_rank) if self.nranks > 1 else \
+            [0] * len(self.AXES)
+        self._coord = dict(zip(self.AXES, coord))
+        self._groups = {
+            a: Group(ranks=list(range(topology.get_dim(a))), axis_name=a, mesh=self.mesh)
+            for a in self.AXES
+        }
+
+    # --- degrees
+    def get_data_parallel_world_size(self):
+        return self._topo.get_dim("dp")
+
+    def get_model_parallel_world_size(self):
+        return self._topo.get_dim("mp")
+
+    def get_pipe_parallel_world_size(self):
+        return self._topo.get_dim("pp")
+
+    def get_sharding_parallel_world_size(self):
+        return self._topo.get_dim("sharding")
+
+    def get_sep_parallel_world_size(self):
+        return self._topo.get_dim("sep")
+
+    # --- ranks within axis
+    def get_data_parallel_rank(self):
+        return self._coord["dp"]
+
+    def get_model_parallel_rank(self):
+        return self._coord["mp"]
+
+    def get_stage_id(self):
+        return self._coord["pp"]
+
+    def get_sharding_parallel_rank(self):
+        return self._coord["sharding"]
+
+    def get_sep_parallel_rank(self):
+        return self._coord["sep"]
+
+    # --- groups
+    def get_data_parallel_group(self):
+        return self._groups["dp"]
+
+    def get_model_parallel_group(self):
+        return self._groups["mp"]
+
+    def get_pipe_parallel_group(self):
+        return self._groups["pp"]
+
+    def get_sharding_parallel_group(self):
+        return self._groups["sharding"]
+
+    def get_sep_parallel_group(self):
+        return self._groups["sep"]
+
+    def get_check_parallel_group(self, sharding=False):
+        return Group(ranks=list(range(self.nranks)))
+
+    def get_data_parallel_group_src_rank(self):
+        return 0
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    def get_p2p_groups(self):
+        return None
+
+    def is_first_stage(self):
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self):
+        return self.get_stage_id() == self.get_pipe_parallel_world_size() - 1
+
+    def topology(self):
+        return self._topo
+
+    def get_parallel_mode(self):
+        from . import meta_parallel as mp
+
+        if self.get_pipe_parallel_world_size() > 1:
+            return "pipeline"
+        if self.get_model_parallel_world_size() > 1:
+            return "tensor"
+        if self.get_sharding_parallel_world_size() > 1:
+            return "sharding"
+        return "data"
+
+
+class PaddleCloudRoleMaker:
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = is_collective
+
+    def worker_num(self):
+        return env.get_world_size()
+
+    def worker_index(self):
+        return env.get_rank()
+
+    def is_worker(self):
+        return True
+
+
+_hybrid_group: HybridCommunicateGroup | None = None
+
+
+def set_hybrid_communicate_group(hcg):
+    global _hybrid_group
+    _hybrid_group = hcg
+
+
+def get_hybrid_communicate_group():
+    return _hybrid_group

@@ -1,0 +1,87 @@
+"""fleet.init / distributed_model / distributed_optimizer.
+Reference: fleet/fleet.py:218,1448; fleet/model.py:33,143-160."""
+from __future__ import annotations
+
+from .. import env
+from .base import (
+    DistributedStrategy,
+    HybridCommunicateGroup,
+    PaddleCloudRoleMaker,
+    get_hybrid_communicate_group,
+    set_hybrid_communicate_group,
+)
+
+
+class Fleet:
+    def __init__(self):
+        self._strategy = None
+        self._hcg = None
+        self._is_initialized = False
+
+    def init(self, role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+        self._strategy = strategy or DistributedStrategy()
+        env.init_parallel_env()
+        self._hcg = HybridCommunicateGroup(strategy=self._strategy)
+        set_hybrid_communicate_group(self._hcg)
+        self._is_initialized = True
+        return self
+
+    @property
+    def strategy(self):
+        return self._strategy
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    def worker_num(self):
+        return env.get_world_size()
+
+    def worker_index(self):
+        return env.get_rank()
+
+    def is_first_worker(self):
+        return env.get_rank() == 0
+
+    def distributed_model(self, model):
+        """Reference model.py:143-160 dispatch: PP model → PipelineParallel wrapper,
+        else TP/sharding/DP wrappers. The wrappers configure sharding recipes over the
+        fleet mesh."""
+        from .meta_parallel import PipelineLayer, PipelineParallel, TensorParallel
+        from ..parallel import DataParallel
+
+        hcg = self._hcg
+        if hcg is None:
+            raise RuntimeError("call fleet.init() first")
+        if isinstance(model, PipelineLayer):
+            return PipelineParallel(model, hcg, self._strategy)
+        if hcg.get_model_parallel_world_size() > 1:
+            return TensorParallel(model, hcg, strategy=self._strategy)
+        if hcg.get_data_parallel_world_size() > 1:
+            return DataParallel(model)
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        from .hybrid_optimizer import HybridParallelOptimizer
+
+        hcg = self._hcg
+        if hcg is None:
+            raise RuntimeError("call fleet.init() first")
+        return HybridParallelOptimizer(optimizer, hcg, self._strategy)
+
+    def barrier_worker(self):
+        pass
+
+
+fleet_obj = Fleet()
+
+
+def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+    return fleet_obj.init(role_maker, is_collective, strategy, log_level)
+
+
+def distributed_model(model):
+    return fleet_obj.distributed_model(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return fleet_obj.distributed_optimizer(optimizer, strategy)

@@ -1,0 +1,35 @@
+"""HybridParallelOptimizer. Reference:
+fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py:275 — wraps the
+inner optimizer, applies grad clip across parallel groups.
+
+On TPU the cross-group norm reduction is implicit (grads are global arrays), so this
+wrapper mainly preserves the API and the clip-before-step ordering.
+"""
+from __future__ import annotations
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, set_to_zero=True):
+        self._inner_opt.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        return self._inner_opt.minimize(loss, startup_program, parameters, no_grad_set)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)

@@ -1,0 +1,336 @@
+"""Fleet meta-parallel: TP layer library + pipeline.
+
+Reference: fleet/layers/mpu/mp_layers.py:49,336,543,744 (VocabParallelEmbedding /
+ColumnParallelLinear / RowParallelLinear / ParallelCrossEntropy),
+fleet/meta_parallel/parallel_layers/pp_layers.py:937 (PipelineLayer),
+fleet/meta_parallel/pipeline_parallel.py:684 (1F1B).
+
+TPU-native design: TP layers hold the FULL logical weight and annotate it with a
+sharding over the 'mp' mesh axis (Shard on the parallel dim). Under jit/GSPMD the
+matmul partitions and the allreduce appears automatically; there is no c_identity /
+c_allreduce op pair to write. Pipeline = host-driven micro-batch schedule over stage
+submodules (1F1B order preserved from the reference).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...nn.layer import Layer
+from ...nn.layer_common import LayerList
+from ...tensor import Tensor
+from ..api import shard_tensor
+from ..mesh import Replicate, Shard, get_mesh
+
+
+def _mp_axis_index(mesh):
+    return mesh.dim_names.index("mp") if mesh and "mp" in mesh.dim_names else None
+
+
+def _mark_mp_shard(param, tensor_dim):
+    """Annotate a parameter as sharded along 'mp' on tensor_dim (device_put if a mesh
+    with an mp axis exists and the dim divides)."""
+    mesh = get_mesh()
+    if mesh is None:
+        return param
+    idx = _mp_axis_index(mesh)
+    if idx is None or mesh.shape[idx] <= 1:
+        return param
+    if param.shape[tensor_dim] % mesh.shape[idx] != 0:
+        return param
+    placements = [Replicate()] * mesh.ndim
+    placements[idx] = Shard(tensor_dim)
+    shard_tensor(param, mesh, placements)
+    param.is_distributed = True
+    return param
+
+
+class VocabParallelEmbedding(Layer):
+    """Reference mp_layers.py:49: embedding table row-sharded over mp ranks."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None, mp_group=None,
+                 name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=I.XavierNormal(),
+        )
+        _mark_mp_shard(self.weight, 0)
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class ColumnParallelLinear(Layer):
+    """Reference mp_layers.py:336: weight [in, out] sharded on out (dim 1);
+    gather_output concatenates shards (on TPU: resharding constraint)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 gather_output=True, fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierUniform(),
+        )
+        _mark_mp_shard(self.weight, 1)
+        self.bias = self.create_parameter([out_features], is_bias=True) if has_bias else None
+        if self.bias is not None:
+            _mark_mp_shard(self.bias, 0)
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        mesh = get_mesh()
+        idx = _mp_axis_index(mesh)
+        if not self.gather_output and mesh is not None and idx is not None and \
+                isinstance(out._value, jax.core.Tracer):
+            # keep activation sharded on last dim along mp
+            from jax.sharding import PartitionSpec
+
+            spec = [None] * (out.ndim - 1) + ["mp"]
+            out._value = jax.lax.with_sharding_constraint(
+                out._value, jax.sharding.NamedSharding(mesh.jax_mesh, PartitionSpec(*spec))
+            )
+        return out
+
+
+class RowParallelLinear(Layer):
+    """Reference mp_layers.py:543: weight [in, out] sharded on in (dim 0); the partial
+    matmul results are summed — GSPMD emits the psum."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=False, fuse_matmul_bias=False, mp_group=None,
+                 name=None):
+        super().__init__()
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierUniform(),
+        )
+        _mark_mp_shard(self.weight, 0)
+        self.bias = self.create_parameter([out_features], is_bias=True) if has_bias else None
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+
+class ParallelCrossEntropy(Layer):
+    """Reference mp_layers.py:744: softmax CE over vocab-sharded logits. With GSPMD the
+    reduction over the sharded vocab axis is compiled into the program."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)
+
+
+# ------------------------------------------------------------------ pipeline layers
+class LayerDesc:
+    """Reference pp_layers.py:57 — lazily-constructed layer spec."""
+
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    """Reference pp_layers.py:77 — layer shared between stages (e.g. tied embeddings)."""
+
+    def __init__(self, key, layer_func, forward_func=None, shared_weight_attr="weight",
+                 *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """Reference pp_layers.py:93 — uniform / custom segmentation of the layer list."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform"):
+        self.layers_desc = layers_desc
+        self.num_parts = num_parts
+        self.method = method
+
+    def do_segment(self):
+        n = len(self.layers_desc)
+        if self.method == "uniform":
+            result = [0]
+            for i in range(1, self.num_parts + 1):
+                result.append((n * i) // self.num_parts)
+            return result
+        if self.method.startswith("layer:"):
+            layer_name = self.method.split(":", 1)[1]
+            marks = [i for i, d in enumerate(self.layers_desc)
+                     if getattr(d.layer_func if isinstance(d, LayerDesc) else type(d),
+                                "__name__", "") == layer_name]
+            # distribute marked layers across parts
+            result = [0]
+            per = len(marks) // self.num_parts
+            for i in range(1, self.num_parts):
+                result.append(marks[i * per])
+            result.append(n)
+            return result
+        raise ValueError(f"unknown segment method {self.method}")
+
+
+class PipelineLayer(Layer):
+    """Reference pp_layers.py:937. Holds the full layer-desc list; builds only the local
+    stage's layers (on TPU single-process we build all stages and the schedule runs them
+    in order — multi-host assigns stages to hosts)."""
+
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0, **kwargs):
+        super().__init__()
+        self.layers_desc = list(layers)
+        self._topo = topology
+        self._loss_fn = loss_fn
+        self._num_stages = num_stages or (topology.get_dim("pipe") if topology else 1)
+        self._recompute_interval = recompute_interval
+        seg = SegmentLayers(self.layers_desc, self._num_stages, seg_method)
+        self.segment_parts = seg.do_segment()
+        self._shared_layers = {}
+        self.run_function = LayerList()
+        self._stage_owned = []  # (start, end) per stage
+        for s in range(self._num_stages):
+            self._stage_owned.append((self.segment_parts[s], self.segment_parts[s + 1]))
+        for desc in self.layers_desc:
+            if isinstance(desc, SharedLayerDesc):
+                if desc.layer_name not in self._shared_layers:
+                    self._shared_layers[desc.layer_name] = desc.build_layer()
+                self.run_function.append(_SharedCaller(
+                    self._shared_layers[desc.layer_name], desc.forward_func))
+            elif isinstance(desc, LayerDesc):
+                self.run_function.append(desc.build_layer())
+            else:
+                self.run_function.append(desc)
+
+    def get_num_stages(self):
+        return self._num_stages
+
+    def stage_layers(self, stage_id):
+        lo, hi = self._stage_owned[stage_id]
+        return [self.run_function[i] for i in range(lo, hi)]
+
+    def forward(self, x):
+        for layer in self.run_function:
+            x = layer(x)
+        return x
+
+    def loss_fn(self, output, label):
+        if self._loss_fn is None:
+            raise RuntimeError("PipelineLayer built without loss_fn")
+        return self._loss_fn(output, label)
+
+
+class _SharedCaller(Layer):
+    def __init__(self, shared, forward_func):
+        super().__init__()
+        self.shared = shared
+        self.forward_func = forward_func
+
+    def forward(self, *args, **kwargs):
+        if self.forward_func is not None:
+            return self.forward_func(self.shared, *args, **kwargs)
+        return self.shared(*args, **kwargs)
+
+
+class PipelineParallel(Layer):
+    """Reference pipeline_parallel.py:242 + 1F1B schedule (:684). Host-driven
+    micro-batch loop over stage submodules; on one device the 1F1B order is preserved
+    so loss/convergence semantics match the reference exactly."""
+
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError("PipelineParallel requires a PipelineLayer model")
+        self._layers = layers
+        self._hcg = hcg
+        cfg = (strategy.pipeline_configs if strategy else {}) or {}
+        self.accumulate_steps = cfg.get("accumulate_steps", 1)
+        self.micro_batch_size = cfg.get("micro_batch_size", 1)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """1F1B semantics on a host loop: forward all chunks' micro-batches with
+        backward interleaved; optimizer.step() after accumulation."""
+        from ...ops.manipulation import split
+
+        x, y = data
+        n_micro = self.accumulate_steps
+        micro_x = split(x, n_micro, axis=0) if n_micro > 1 else [x]
+        micro_y = split(y, n_micro, axis=0) if n_micro > 1 else [y]
+        total_loss = None
+        for mx, my in zip(micro_x, micro_y):
+            out = self._layers(mx)
+            loss = self._layers.loss_fn(out, my)
+            scaled = loss / float(n_micro)
+            if scaler is not None:
+                scaler.scale(scaled).backward()
+            else:
+                scaled.backward()
+            total_loss = loss.detach() if total_loss is None else total_loss + loss.detach()
+        if scaler is not None:
+            scaler.step(optimizer)
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return total_loss.scale(1.0 / n_micro)
+
+    def eval_batch(self, data, compute_loss=True):
+        x, y = data
+        out = self._layers(x)
+        if compute_loss:
+            return self._layers.loss_fn(out, y)
+        return out
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
+
+
+class TensorParallel(Layer):
+    """Reference fleet/meta_parallel/tensor_parallel.py:28 — thin wrapper; TP layers
+    already carry their shardings."""
+
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
+
+
+class SegmentParallel(Layer):
+    """Reference fleet/meta_parallel/segment_parallel.py:26 — sequence split over the
+    'sep' axis; with GSPMD this is an activation sharding recipe."""
+
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)

@@ -1,0 +1,59 @@
+"""Activation recompute. Reference: fleet/recompute/recompute.py:463.
+
+TPU-native: jax.checkpoint (rematerialization) — the compiler replays the forward in
+the backward pass, trading FLOPs for HBM exactly like the reference's
+RecomputeFunction, but fused into the XLA program.
+"""
+from __future__ import annotations
+
+import jax
+
+from ...ops import apply_op
+from ...tensor import Tensor
+
+
+def recompute(function, *args, **kwargs):
+    """Run `function(*args)` under rematerialization. Under the tape, we wrap the whole
+    call as one node whose vjp re-runs the forward (jax.checkpoint semantics)."""
+    use_reentrant = kwargs.pop("use_reentrant", True)
+    preserve_rng_state = kwargs.pop("preserve_rng_state", True)
+
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+
+    def raw_fn(*vals):
+        it = iter(vals)
+        call_args = [next(it) if isinstance(a, Tensor) else a for a in args]
+        wrapped = [Tensor(v, stop_gradient=True) if not isinstance(v, Tensor) else v
+                   for v in call_args]
+        # run the layer body with tape off — jax.checkpoint handles the rematerialized
+        # gradient; tape sees one fused node.
+        from ...autograd import tape as _tape
+
+        with _tape.no_grad():
+            out = function(*wrapped, **kwargs)
+        if isinstance(out, (tuple, list)):
+            return tuple(o._value if isinstance(o, Tensor) else o for o in out)
+        return out._value if isinstance(out, Tensor) else out
+
+    ckpt_fn = jax.checkpoint(raw_fn)
+    return apply_op(ckpt_fn, "recompute", *tensor_args)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Reference: recompute_sequential — chunked recompute over a Sequential."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    layers = list(functions)
+    n = len(layers)
+    per = (n + segments - 1) // segments
+    out = args[0] if len(args) == 1 else args
+
+    for i in range(0, n, per):
+        chunk = layers[i:i + per]
+
+        def seg_fn(x, _chunk=chunk):
+            for l in _chunk:
+                x = l(x)
+            return x
+
+        out = recompute(seg_fn, out, **kwargs)
+    return out

@@ -1,0 +1,211 @@
+"""ProcessMesh + placements: the spine of the distributed design.
+
+Reference parity: `paddle.distributed.ProcessMesh` + `Shard/Replicate/Partial`
+(python/paddle/distributed/auto_parallel/api.py, placement_types in
+paddle/phi/core/distributed/auto_parallel/placement_types.h). TPU-native: a ProcessMesh
+wraps a `jax.sharding.Mesh`; placements translate to `jax.sharding.PartitionSpec` and
+GSPMD inserts the collectives (SURVEY.md §5: "delete the NCCL layer concept").
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicate(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Shard(Placement):
+    def __init__(self, dim):
+        self.dim = dim
+
+    def is_shard(self, dim=None):
+        return True if dim is None else dim == self.dim
+
+    def get_dim(self):
+        return self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+
+class Replicate(Placement):
+    def is_replicate(self):
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+
+class Partial(Placement):
+    """Pending-reduction placement. GSPMD has no user-visible partial state; we model it
+    as replicate + a recorded reduce op so `reshard` to Replicate emits the reduction
+    (mirrors reference p_to_r reshard function)."""
+
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("partial", self.reduce_type))
+
+
+class ProcessMesh:
+    """Reference: auto_parallel ProcessMesh(mesh, dim_names). Backed by jax Mesh over
+    the available devices (or a subset)."""
+
+    def __init__(self, mesh, dim_names=None, process_ids=None):
+        arr = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        self._shape = list(arr.shape)
+        self._dim_names = list(dim_names)
+        self._process_ids = arr.reshape(-1).tolist()
+        devices = jax.devices()
+        n = arr.size
+        if n > len(devices):
+            raise ValueError(
+                f"mesh needs {n} devices but only {len(devices)} available; for CPU "
+                f"testing set XLA_FLAGS=--xla_force_host_platform_device_count={n}"
+            )
+        dev_arr = np.asarray([devices[i] for i in arr.reshape(-1)]).reshape(arr.shape)
+        self._jax_mesh = Mesh(dev_arr, axis_names=tuple(self._dim_names))
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self):
+        return list(self._process_ids)
+
+    @property
+    def mesh(self):
+        return np.asarray(self._process_ids).reshape(self._shape)
+
+    @property
+    def jax_mesh(self) -> Mesh:
+        return self._jax_mesh
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    def get_dim_size(self, name):
+        return self._shape[self._dim_names.index(name)]
+
+    def get_rank_by_dim_and_process_id(self, dim, pid):
+        idx = self._process_ids.index(pid)
+        coords = np.unravel_index(idx, self._shape)
+        return int(coords[self._dim_names.index(dim) if isinstance(dim, str) else dim])
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, ProcessMesh)
+            and other._shape == self._shape
+            and other._dim_names == self._dim_names
+            and other._process_ids == self._process_ids
+        )
+
+    def __hash__(self):
+        return hash((tuple(self._shape), tuple(self._dim_names), tuple(self._process_ids)))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self._shape}, dim_names={self._dim_names})"
+
+
+_global_mesh: ProcessMesh | None = None
+
+
+def set_mesh(mesh: ProcessMesh):
+    global _global_mesh
+    _global_mesh = mesh
+    return mesh
+
+
+def get_mesh() -> ProcessMesh | None:
+    return _global_mesh
+
+
+def auto_mesh(*axis_sizes, dim_names=None) -> ProcessMesh:
+    """Build a mesh over all devices with the given axis sizes (row-major)."""
+    n = int(np.prod(axis_sizes))
+    ids = np.arange(n).reshape(axis_sizes)
+    return ProcessMesh(ids, dim_names)
+
+
+def placements_to_spec(placements, ndim) -> PartitionSpec:
+    """Translate paddle placements (index = mesh dim) to a PartitionSpec (index = tensor
+    dim). Multiple mesh axes sharding the same tensor dim become a tuple entry."""
+    entries: list = [None] * ndim
+    return _placements_to_spec_entries(placements, entries)
+
+
+def _placements_to_spec_entries(placements, entries):
+    mesh = get_mesh()
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            tdim = pl.get_dim()
+            name = None
+            if mesh is not None and mesh_dim < len(mesh.dim_names):
+                name = mesh.dim_names[mesh_dim]
+            if entries[tdim] is None:
+                entries[tdim] = name
+            elif isinstance(entries[tdim], tuple):
+                entries[tdim] = entries[tdim] + (name,)
+            else:
+                entries[tdim] = (entries[tdim], name)
+    return PartitionSpec(*entries)
+
+
+def spec_for(mesh: ProcessMesh, placements, ndim) -> PartitionSpec:
+    entries: list = [None] * ndim
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            tdim = pl.get_dim()
+            name = mesh.dim_names[mesh_dim]
+            if entries[tdim] is None:
+                entries[tdim] = name
+            elif isinstance(entries[tdim], tuple):
+                entries[tdim] = entries[tdim] + (name,)
+            else:
+                entries[tdim] = (entries[tdim], name)
+    return PartitionSpec(*entries)
+
+
+def sharding_for(mesh: ProcessMesh, placements, ndim) -> NamedSharding:
+    return NamedSharding(mesh.jax_mesh, spec_for(mesh, placements, ndim))

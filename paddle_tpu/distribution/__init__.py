@@ -1,0 +1,180 @@
+"""Probability distributions. Reference: python/paddle/distribution/ (9.3K LoC).
+Round-1 core set: Normal/Uniform/Categorical/Bernoulli + kl_divergence."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import random as _rng
+from ..ops import apply_op
+from ..tensor import Tensor
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "kl_divergence", "register_kl"]
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x, jnp.float32)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        from ..ops.math import exp
+
+        return exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(np.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self._batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(jnp.square(self.scale), self._batch_shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        z = jax.random.normal(_rng.next_key(), shape, dtype=jnp.result_type(self.loc))
+        return Tensor(self.loc + self.scale * z)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def f(v):
+            var = jnp.square(self.scale)
+            return -jnp.square(v - self.loc) / (2 * var) - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi)
+
+        return apply_op(f, "normal_log_prob", value)
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(
+            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale), self._batch_shape))
+
+    def kl_divergence(self, other):
+        var_ratio = jnp.square(self.scale / other.scale)
+        t1 = jnp.square((self.loc - other.loc) / other.scale)
+        return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _val(low)
+        self.high = _val(high)
+        super().__init__(np.broadcast_shapes(self.low.shape, self.high.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        u = jax.random.uniform(_rng.next_key(), shape)
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        def f(v):
+            inside = (v >= self.low) & (v < self.high)
+            return jnp.where(inside, -jnp.log(self.high - self.low), -jnp.inf)
+
+        return apply_op(f, "uniform_log_prob", value)
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _val(logits)
+        super().__init__(self.logits.shape[:-1])
+
+    def sample(self, shape=()):
+        n = int(np.prod(shape)) if shape else 1
+        out = jax.random.categorical(_rng.next_key(), self.logits,
+                                     shape=tuple(shape) + self._batch_shape)
+        return Tensor(out.astype(jnp.int64))
+
+    def log_prob(self, value):
+        def f(v):
+            logp = jax.nn.log_softmax(self.logits, axis=-1)
+            return jnp.take_along_axis(logp, v.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+
+        return apply_op(f, "categorical_log_prob", value)
+
+    def probs(self, value=None):
+        p = jax.nn.softmax(self.logits, axis=-1)
+        if value is None:
+            return Tensor(p)
+        return Tensor(jnp.take_along_axis(p, _val(value).astype(jnp.int32)[..., None],
+                                          axis=-1)[..., 0])
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return Tensor(-jnp.sum(jnp.exp(logp) * logp, axis=-1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_v = _val(probs)
+        super().__init__(self.probs_v.shape)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        u = jax.random.uniform(_rng.next_key(), shape)
+        return Tensor((u < self.probs_v).astype(jnp.float32))
+
+    def log_prob(self, value):
+        def f(v):
+            p = jnp.clip(self.probs_v, 1e-7, 1 - 1e-7)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+
+        return apply_op(f, "bernoulli_log_prob", value)
+
+    def entropy(self):
+        p = jnp.clip(self.probs_v, 1e-7, 1 - 1e-7)
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+_KL_REGISTRY = {}
+
+
+def register_kl(type_p, type_q):
+    def decorator(fn):
+        _KL_REGISTRY[(type_p, type_q)] = fn
+        return fn
+
+    return decorator
+
+
+def kl_divergence(p, q):
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is not None:
+        return fn(p, q)
+    if hasattr(p, "kl_divergence"):
+        return p.kl_divergence(q)
+    raise NotImplementedError(f"no KL registered for {type(p)} vs {type(q)}")

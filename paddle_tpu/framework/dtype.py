@@ -1,0 +1,110 @@
+"""Dtype system.
+
+Reference parity: paddle exposes dtypes as `paddle.float32`, `paddle.int64`, ... and a
+`get_default_dtype`/`set_default_dtype` pair (python/paddle/framework/framework.py in the
+reference). Here dtypes ARE numpy/jax dtypes — no custom enum: XLA is the only backend, so
+jnp dtypes are the native currency and everything interops with numpy for free.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects (jax dtypes). bfloat16 is the TPU-native half type.
+bool_ = jnp.bool_.dtype if hasattr(jnp.bool_, "dtype") else np.dtype(bool)
+uint8 = np.dtype("uint8")
+int8 = np.dtype("int8")
+int16 = np.dtype("int16")
+int32 = np.dtype("int32")
+int64 = np.dtype("int64")
+float16 = np.dtype("float16")
+bfloat16 = jnp.bfloat16.dtype if hasattr(jnp.bfloat16, "dtype") else jnp.dtype(jnp.bfloat16)
+float32 = np.dtype("float32")
+float64 = np.dtype("float64")
+complex64 = np.dtype("complex64")
+complex128 = np.dtype("complex128")
+float8_e4m3fn = jnp.float8_e4m3fn.dtype if hasattr(jnp, "float8_e4m3fn") else None
+float8_e5m2 = jnp.float8_e5m2.dtype if hasattr(jnp, "float8_e5m2") else None
+
+_STR_ALIASES = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+    "fp16": float16,
+    "fp32": float32,
+    "fp64": float64,
+}
+
+
+def convert_dtype(dtype):
+    """Normalize any user-facing dtype spec (str, np.dtype, jnp type) to an np.dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        key = dtype.lower()
+        if key in _STR_ALIASES:
+            return _STR_ALIASES[key]
+        return np.dtype(dtype)
+    if isinstance(dtype, np.dtype):
+        return dtype
+    # jnp scalar types (jnp.float32 etc.) and python builtins
+    try:
+        return jnp.dtype(dtype)
+    except TypeError:
+        return np.dtype(dtype)
+
+
+def dtype_to_str(dtype) -> str:
+    d = convert_dtype(dtype)
+    return d.name if d is not None else "None"
+
+
+_default_dtype = float32
+
+
+def set_default_dtype(d):
+    """paddle.set_default_dtype — only floating point types are legal (matches reference)."""
+    global _default_dtype
+    d = convert_dtype(d)
+    if d not in (float16, bfloat16, float32, float64):
+        raise TypeError(
+            "set_default_dtype only supports float16/bfloat16/float32/float64, got %s" % d
+        )
+    _default_dtype = d
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def is_floating_dtype(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return jnp.issubdtype(d, jnp.floating)
+
+
+def is_integer_dtype(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return jnp.issubdtype(d, jnp.integer)
+
+
+def is_complex_dtype(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return jnp.issubdtype(d, jnp.complexfloating)
+
+
+def finfo(dtype):
+    return jnp.finfo(convert_dtype(dtype))
+
+
+def iinfo(dtype):
+    return jnp.iinfo(convert_dtype(dtype))

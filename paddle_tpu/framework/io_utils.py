@@ -1,0 +1,57 @@
+"""paddle.save / paddle.load. Reference: python/paddle/framework/io.py (pickle-based).
+
+Arrays are stored as numpy inside the pickle (like the reference); Tensors round-trip.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..tensor import Tensor
+
+
+class _TensorPayload:
+    def __init__(self, array, stop_gradient):
+        self.array = array
+        self.stop_gradient = stop_gradient
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj._value), obj.stop_gradient)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_pack(v) for v in obj]
+    if isinstance(obj, tuple):
+        return tuple(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj):
+    import jax.numpy as jnp
+
+    if isinstance(obj, _TensorPayload):
+        return Tensor(jnp.asarray(obj.array), stop_gradient=obj.stop_gradient)
+    if isinstance(obj, dict):
+        return {k: _unpack(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_unpack(v) for v in obj]
+    if isinstance(obj, tuple):
+        return tuple(_unpack(v) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    with open(path, "rb") as f:
+        return _unpack(pickle.load(f))

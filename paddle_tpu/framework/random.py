@@ -1,0 +1,137 @@
+"""Stateless RNG with a stateful facade.
+
+Reference parity: paddle.seed / paddle.get_rng_state; fleet's `RNGStatesTracker`
+(python/paddle/distributed/fleet/layers/mpu/random.py:34 in the reference) keeps distinct
+dropout streams across tensor-parallel ranks. TPU-native design: a single jax PRNG key plus
+a split counter. Every random op folds the counter into the key — pure data flow, no device
+state, reproducible under jit (the counter is captured at trace time per call site).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import numpy as np
+
+
+class Generator:
+    """A stateful wrapper over a jax PRNG key chain."""
+
+    def __init__(self, seed: int = 0):
+        self.manual_seed(seed)
+
+    def manual_seed(self, seed: int):
+        self._seed = int(seed)
+        self._key = jax.random.key(self._seed)
+        self._counter = 0
+        return self
+
+    def next_key(self):
+        """Return a fresh key; advances the stream. Under a TrainStep trace a traced
+        base key is folded in instead of the host key, so compiled steps get fresh
+        randomness per call rather than a baked-in constant."""
+        base = _trace_key if _trace_key is not None else self._key
+        k = jax.random.fold_in(base, self._counter)
+        self._counter += 1
+        return k
+
+    def get_state(self):
+        return (self._seed, self._counter)
+
+    def set_state(self, state):
+        self._seed, self._counter = state
+        self._key = jax.random.key(self._seed)
+        return self
+
+
+_default_generator = Generator(np.random.randint(0, 2**31 - 1))
+_trace_key = None
+
+
+@contextlib.contextmanager
+def trace_key(key):
+    """Route random ops through a traced base key (used by compiled train steps)."""
+    global _trace_key
+    prev = _trace_key
+    _trace_key = key
+    try:
+        yield
+    finally:
+        _trace_key = prev
+
+
+def seed(s: int) -> Generator:
+    """paddle.seed"""
+    return _default_generator.manual_seed(s)
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def next_key():
+    return _default_generator.next_key()
+
+
+def get_rng_state():
+    return _default_generator.get_state()
+
+
+def set_rng_state(state):
+    _default_generator.set_state(state)
+
+
+class RNGStatesTracker:
+    """Named RNG streams (reference: mpu/random.py RNGStatesTracker).
+
+    Used by tensor parallelism: 'global_seed' stream is identical across TP ranks
+    (e.g. for residual dropout), 'local_seed' differs per rank (weight init / dropout on
+    sharded activations). Streams are independent Generators.
+    """
+
+    def __init__(self):
+        self._states: dict[str, Generator] = {}
+
+    def reset(self):
+        self._states = {}
+
+    def add(self, name: str, s: int):
+        if name in self._states:
+            raise ValueError(f"state {name!r} already exists")
+        self._states[name] = Generator(s)
+
+    def get_states_tracker(self):
+        return {k: g.get_state() for k, g in self._states.items()}
+
+    def set_states_tracker(self, states):
+        self._states = {k: Generator(0).set_state(v) for k, v in states.items()}
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = "global_seed"):
+        """Temporarily make the named stream the default generator."""
+        global _default_generator
+        if name not in self._states:
+            raise ValueError(f"state {name!r} not added yet")
+        prev = _default_generator
+        _default_generator = self._states[name]
+        try:
+            yield
+        finally:
+            _default_generator = prev
+
+
+_rng_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _rng_tracker
+
+
+def model_parallel_random_seed(seed_: int, tp_rank: int = 0):
+    """Reference: mpu/random.py model_parallel_random_seed — set up global/local streams."""
+    global_seed = 100003 + seed_
+    local_seed = seed_ + 1024 + tp_rank * 100
+    _rng_tracker.reset()
+    _rng_tracker.add("global_seed", global_seed)
+    _rng_tracker.add("local_seed", local_seed)
+    seed(global_seed)

@@ -1,0 +1,172 @@
+"""hapi callbacks. Reference: python/paddle/hapi/callbacks.py."""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+class Callback:
+    def set_model(self, model):
+        self.model = model
+
+    def set_params(self, params):
+        self.params = params
+
+    def on_begin(self, mode, logs=None):
+        pass
+
+    def on_end(self, mode, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_batch_begin(self, mode, step, logs=None):
+        pass
+
+    def on_batch_end(self, mode, step, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+
+class CallbackList:
+    def __init__(self, callbacks=None):
+        self.callbacks = list(callbacks or [])
+
+    def set_model(self, model):
+        for c in self.callbacks:
+            c.set_model(model)
+
+    def __getattr__(self, name):
+        def dispatch(*args, **kwargs):
+            for c in self.callbacks:
+                getattr(c, name)(*args, **kwargs)
+
+        if name.startswith("on_"):
+            return dispatch
+        raise AttributeError(name)
+
+
+class ProgBarLogger(Callback):
+    def __init__(self, log_freq=1, verbose=2):
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+        self.steps = 0
+        self.start = time.time()
+
+    def on_batch_end(self, mode, step, logs=None):
+        self.steps += 1
+        if self.verbose and step % self.log_freq == 0:
+            items = ", ".join(
+                f"{k}: {np.asarray(v).reshape(-1)[0]:.4f}" if not isinstance(v, str)
+                else f"{k}: {v}" for k, v in (logs or {}).items()
+            )
+            ips = self.steps / max(time.time() - self.start, 1e-9)
+            print(f"[train] epoch {self.epoch} step {step}: {items} ({ips:.1f} steps/s)")
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            print(f"[train] epoch {epoch} done in {time.time() - self.start:.1f}s")
+
+
+class ModelCheckpoint(Callback):
+    def __init__(self, save_freq=1, save_dir=None):
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and epoch % self.save_freq == 0:
+            self.model.save(f"{self.save_dir}/{epoch}")
+
+
+class LRScheduler(Callback):
+    def __init__(self, by_step=True, by_epoch=False):
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        opt = getattr(self.model, "_optimizer", None)
+        from ..optimizer.lr import LRScheduler as Sched
+
+        if opt and isinstance(opt._learning_rate, Sched):
+            return opt._learning_rate
+        return None
+
+    def on_batch_end(self, mode, step, logs=None):
+        if mode == "train" and self.by_step:
+            s = self._sched()
+            if s:
+                s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.by_epoch:
+            s = self._sched()
+            if s:
+                s.step()
+
+
+class EarlyStopping(Callback):
+    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
+                 min_delta=0, baseline=None, save_best_model=True):
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = min_delta
+        self.best = None
+        self.wait = 0
+        self.mode = "min" if mode in ("auto", "min") else "max"
+
+    def on_epoch_end(self, epoch, logs=None):
+        val = (logs or {}).get(self.monitor)
+        if val is None:
+            return
+        val = float(np.asarray(val).reshape(-1)[0])
+        improved = (
+            self.best is None
+            or (self.mode == "min" and val < self.best - self.min_delta)
+            or (self.mode == "max" and val > self.best + self.min_delta)
+        )
+        if improved:
+            self.best = val
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.model.stop_training = True
+
+
+class VisualDL(Callback):
+    """Scalar logger writing TSV (VisualDL itself is external; format is greppable)."""
+
+    def __init__(self, log_dir="./log"):
+        self.log_dir = log_dir
+        self._fh = None
+
+    def on_begin(self, mode, logs=None):
+        import os
+
+        os.makedirs(self.log_dir, exist_ok=True)
+        self._fh = open(f"{self.log_dir}/scalars.tsv", "a")
+
+    def on_batch_end(self, mode, step, logs=None):
+        if self._fh:
+            for k, v in (logs or {}).items():
+                try:
+                    self._fh.write(f"{mode}\t{step}\t{k}\t{float(np.asarray(v).reshape(-1)[0])}\n")
+                except Exception:
+                    pass
+
+    def on_end(self, mode, logs=None):
+        if self._fh:
+            self._fh.close()

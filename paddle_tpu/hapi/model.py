@@ -1,0 +1,157 @@
+"""paddle.Model high-level train loop. Reference: python/paddle/hapi/model.py:1472
+(fit), with callbacks + metrics."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io import DataLoader, Dataset
+from ..metric import Metric
+from ..tensor import Tensor
+from .callbacks import CallbackList, ProgBarLogger
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is not None:
+            self._metrics = metrics if isinstance(metrics, (list, tuple)) else [metrics]
+        return self
+
+    def _compute_loss(self, outputs, labels):
+        if callable(self._loss):
+            return self._loss(outputs, labels)
+        raise RuntimeError("call prepare(loss=...) first")
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        out = self.network(*inputs)
+        loss = self._compute_loss(out, labels)
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics_out = [float(np.asarray(loss._value))]
+        for m in self._metrics:
+            res = m.compute(out, labels)
+            m.update(res)
+        return metrics_out
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        from ..autograd import no_grad
+
+        with no_grad():
+            out = self.network(*inputs)
+            loss = self._compute_loss(out, labels)
+            for m in self._metrics:
+                res = m.compute(out, labels)
+                m.update(res)
+        return [float(np.asarray(loss._value))]
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        from ..autograd import no_grad
+
+        with no_grad():
+            return self.network(*inputs)
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None, **kwargs):
+        loader = train_data if isinstance(train_data, DataLoader) else DataLoader(
+            train_data, batch_size=batch_size, shuffle=shuffle, drop_last=drop_last,
+            num_workers=num_workers)
+        cbks = CallbackList(callbacks or [ProgBarLogger(log_freq, verbose=verbose)])
+        cbks.set_model(self)
+        cbks.on_begin("train")
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            for step, batch in enumerate(loader):
+                cbks.on_batch_begin("train", step, None)
+                x, y = batch[0], batch[1] if len(batch) > 1 else None
+                logs = {"loss": self.train_batch(x, y)}
+                for m in self._metrics:
+                    names = m.name()
+                    vals = m.accumulate()
+                    if not isinstance(vals, (list, tuple)):
+                        vals = [vals]
+                        names = [names] if isinstance(names, str) else names
+                    logs.update(dict(zip(names, vals)))
+                cbks.on_batch_end("train", step, logs)
+            cbks.on_epoch_end(epoch, logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size, verbose=0)
+            if save_dir is not None and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/epoch_{epoch}")
+            if self.stop_training:
+                break
+        cbks.on_end("train")
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2, num_workers=0,
+                 callbacks=None, **kwargs):
+        loader = eval_data if isinstance(eval_data, DataLoader) else DataLoader(
+            eval_data, batch_size=batch_size, num_workers=num_workers)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            x, y = batch[0], batch[1] if len(batch) > 1 else None
+            losses.append(self.eval_batch(x, y))
+        logs = {"loss": list(np.mean(losses, axis=0)) if losses else []}
+        for m in self._metrics:
+            names = m.name()
+            vals = m.accumulate()
+            if not isinstance(vals, (list, tuple)):
+                vals, names = [vals], ([names] if isinstance(names, str) else names)
+            logs.update(dict(zip(names, vals)))
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = test_data if isinstance(test_data, DataLoader) else DataLoader(
+            test_data, batch_size=batch_size, num_workers=num_workers)
+        outs = []
+        for batch in loader:
+            x = batch[0] if isinstance(batch, (list, tuple)) else batch
+            outs.append(self.predict_batch(x))
+        return outs
+
+    def save(self, path, training=True):
+        from ..framework.io_utils import save as _save
+
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io_utils import load as _load
+
+        self.network.set_state_dict(_load(path + ".pdparams"))
+        import os
+
+        if not reset_optimizer and self._optimizer is not None and os.path.exists(
+                path + ".pdopt"):
+            self._optimizer.set_state_dict(_load(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        n_params = sum(p.size for p in self.network.parameters())
+        trainable = sum(p.size for p in self.network.parameters() if not p.stop_gradient)
+        print(f"Total params: {n_params}")
+        print(f"Trainable params: {trainable}")
+        return {"total_params": n_params, "trainable_params": trainable}

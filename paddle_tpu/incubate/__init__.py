@@ -1,0 +1,2 @@
+"""paddle.incubate staging ground. Reference: python/paddle/incubate/."""
+from . import nn  # noqa: F401

@@ -1,0 +1,9 @@
+"""Fused transformer blocks. Reference: python/paddle/incubate/nn/layer/
+fused_transformer.py:213,534,750. On TPU "fused" means: written so XLA emits one fused
+region — same API, compiler does the fusion."""
+from .fused_transformer import (  # noqa: F401
+    FusedFeedForward,
+    FusedMultiHeadAttention,
+    FusedTransformerEncoderLayer,
+)
+from . import functional  # noqa: F401

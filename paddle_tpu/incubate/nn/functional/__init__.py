@@ -1,0 +1,146 @@
+"""incubate.nn.functional: fused functional ops (API parity; XLA does the fusing).
+Reference: python/paddle/incubate/nn/functional/."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....ops import apply_op
+
+__all__ = ["fused_linear", "fused_bias_act", "fused_rotary_position_embedding",
+           "fused_rms_norm", "fused_layer_norm", "swiglu"]
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    def f(v, w, b):
+        if transpose_weight:
+            w = w.T
+        out = v @ w
+        return out + b if b is not None else out
+
+    return apply_op(f, "fused_linear", x, weight, bias)
+
+
+def fused_bias_act(x, bias=None, dequant_scales=None, shift=None, smooth=None,
+                   act_method="gelu", compute_dtype="default", quant_scale=-1,
+                   quant_round_type=0, quant_max_bound=0, quant_min_bound=0):
+    def f(v, b):
+        if b is not None:
+            v = v + b
+        if act_method == "gelu":
+            return jax.nn.gelu(v)
+        if act_method in ("swiglu",):
+            a, g = jnp.split(v, 2, axis=-1)
+            return jax.nn.silu(a) * g
+        return getattr(jax.nn, act_method)(v)
+
+    return apply_op(f, "fused_bias_act", x, bias)
+
+
+def swiglu(x, y=None, name=None):
+    if y is not None:
+        return apply_op(lambda a, b: jax.nn.silu(a) * b, "swiglu", x, y)
+
+    def f(v):
+        a, b = jnp.split(v, 2, axis=-1)
+        return jax.nn.silu(a) * b
+
+    return apply_op(f, "swiglu", x)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    time_major=False, rotary_emb_base=10000.0):
+    """Reference: fused_rope (paddle/phi/kernels/fusion/gpu/fused_rope_kernel.cu).
+    Layout [batch, seq, heads, head_dim]."""
+
+    def rope_one(t, sin_v, cos_v):
+        if t is None:
+            return None
+        if use_neox_rotary_style:
+            half = t.shape[-1] // 2
+            t1 = t[..., :half]
+            t2 = t[..., half:]
+            rot = jnp.concatenate([-t2, t1], axis=-1)
+        else:
+            t1 = t[..., 0::2]
+            t2 = t[..., 1::2]
+            rot = jnp.stack([-t2, t1], axis=-1).reshape(t.shape)
+        return t * cos_v + rot * sin_v
+
+    def f(qv, kv, vv, sin_v, cos_v, pos):
+        S = qv.shape[1]
+        D = qv.shape[-1]
+        if sin_v is None:
+            inv = 1.0 / (rotary_emb_base ** (jnp.arange(0, D, 2, dtype=jnp.float32) / D))
+            pos_seq = jnp.arange(S, dtype=jnp.float32)
+            freqs = jnp.outer(pos_seq, inv)
+            if use_neox_rotary_style:
+                emb = jnp.concatenate([freqs, freqs], axis=-1)
+            else:
+                emb = jnp.repeat(freqs, 2, axis=-1)
+            sin_v = jnp.sin(emb)[None, :, None, :]
+            cos_v = jnp.cos(emb)[None, :, None, :]
+        else:
+            if sin_v.ndim == 2:
+                sin_v = sin_v[None, :, None, :]
+                cos_v = cos_v[None, :, None, :]
+            elif sin_v.ndim == 4 and sin_v.shape[2] != 1:
+                pass
+        if pos is not None:
+            sin_v = jnp.take(sin_v[0, :, 0], pos.astype(jnp.int32), axis=0)[:, :, None, :]
+            cos_v = jnp.take(cos_v[0, :, 0], pos.astype(jnp.int32), axis=0)[:, :, None, :]
+        sin_v = sin_v.astype(qv.dtype)
+        cos_v = cos_v.astype(qv.dtype)
+        outs = tuple(rope_one(t, sin_v, cos_v) for t in (qv, kv, vv) if t is not None)
+        n_none = sum(t is None for t in (qv, kv, vv))
+        full = []
+        it = iter(outs)
+        for t in (qv, kv, vv):
+            full.append(None if t is None else next(it))
+        return tuple(x for x in full if x is not None) if len(outs) > 1 else outs[0]
+
+    out = apply_op(f, "fused_rope", q, k, v, sin, cos, position_ids)
+    if isinstance(out, tuple):
+        res = list(out)
+        while len(res) < 3:
+            res.append(None)
+        return tuple(res[:3])
+    return out, None, None
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6, begin_norm_axis=-1,
+                   bias=None, residual=None, quant_scale=-1, quant_round_type=0,
+                   quant_max_bound=0, quant_min_bound=0):
+    def f(v, w, b, extra_bias, res):
+        if extra_bias is not None:
+            v = v + extra_bias
+        if res is not None:
+            v = v + res
+        ms = jnp.mean(jnp.square(v.astype(jnp.float32)), axis=-1, keepdims=True)
+        out = (v.astype(jnp.float32) * jax.lax.rsqrt(ms + epsilon)).astype(v.dtype)
+        out = out * w
+        if b is not None:
+            out = out + b
+        return out
+
+    return apply_op(f, "fused_rms_norm", x, norm_weight, norm_bias, bias, residual)
+
+
+def fused_layer_norm(x, norm_weight, norm_bias=None, epsilon=1e-5, begin_norm_axis=-1,
+                     bias=None, residual=None, **kw):
+    def f(v, w, b, extra_bias, res):
+        if extra_bias is not None:
+            v = v + extra_bias
+        if res is not None:
+            v = v + res
+        mean = jnp.mean(v, axis=-1, keepdims=True)
+        var = jnp.var(v, axis=-1, keepdims=True)
+        out = (v - mean) * jax.lax.rsqrt(var + epsilon)
+        if w is not None:
+            out = out * w
+        if b is not None:
+            out = out + b
+        return out
+
+    return apply_op(f, "fused_layer_norm", x, norm_weight, norm_bias, bias, residual)

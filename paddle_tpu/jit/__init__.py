@@ -1,0 +1,200 @@
+"""paddle.jit: trace-and-compile. Reference: python/paddle/jit/api.py:197 (to_static),
+SOT + AST tracers under python/paddle/jit/{sot,dy2static}.
+
+TPU-native replacement for the whole SOT/AST/PIR pipeline: the op layer already runs on
+jax, so `to_static` is jax.jit over the Python function — Python IS the tracer, XLA is
+the compiler. Guards/graph-breaks are unnecessary: jit retraces per (structure, shape,
+dtype) signature automatically; data-dependent Python control flow raises a clear
+TracerBoolConversionError instead of silently graph-breaking.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import pickle
+
+import jax
+import numpy as np
+
+from ..nn.layer import Layer
+from ..tensor import Tensor
+
+__all__ = ["to_static", "not_to_static", "ignore_module", "save", "load",
+           "enable_to_static", "TrainStep"]
+
+
+def __getattr__(name):
+    if name == "TrainStep":
+        from .train import TrainStep
+
+        return TrainStep
+    raise AttributeError(name)
+
+_to_static_enabled = True
+
+
+def enable_to_static(flag: bool):
+    global _to_static_enabled
+    _to_static_enabled = flag
+
+
+def not_to_static(fn=None):
+    if fn is None:
+        return lambda f: f
+    fn._jit_skip = True
+    return fn
+
+
+def ignore_module(modules):
+    return None
+
+
+class StaticFunction:
+    """Compiled callable. For Layers / bound Layer methods, parameters and buffers are
+    threaded through the jit boundary as inputs so in-place updates (optimizer steps,
+    batch-norm stats) are observed — the reference achieves the same via parameter
+    scope capture in its partial programs (python/paddle/jit/dy2static/
+    pir_partial_program.py)."""
+
+    def __init__(self, function, input_spec=None, build_strategy=None, backend=None,
+                 full_graph=True):
+        self._raw_fn = function
+        self._layer = None
+        fn = function
+        if isinstance(function, Layer):
+            self._layer = function
+            fn = type(function).forward
+        elif hasattr(function, "__self__") and isinstance(function.__self__, Layer):
+            self._layer = function.__self__
+            fn = function.__func__
+        self._fn = fn
+        self._input_spec = input_spec
+        self._cache = {}
+        functools.update_wrapper(self, fn)
+
+    @property
+    def layer(self):
+        return self._layer
+
+    def _jitted(self):
+        if "jit" in self._cache:
+            return self._cache["jit"]
+        layer = self._layer
+        fn = self._fn
+
+        if layer is not None:
+            def run(state, training, args, kwargs):
+                prev = layer.training
+                for l in layer.sublayers(include_self=True):
+                    l.training = training
+                try:
+                    return layer.functional_call(state, *args, **kwargs) if fn is type(
+                        layer).forward else _call_method(layer, fn, state, args, kwargs)
+                finally:
+                    for l in layer.sublayers(include_self=True):
+                        l.training = prev
+
+            jitted = jax.jit(run, static_argnums=(1,))
+        else:
+            def run(args, kwargs):
+                return fn(*args, **kwargs)
+
+            jitted = jax.jit(run)
+        self._cache["jit"] = jitted
+        return jitted
+
+    def __call__(self, *args, **kwargs):
+        if not _to_static_enabled:
+            if self._layer is not None and self._fn is type(self._layer).forward:
+                return self._layer(*args, **kwargs)
+            return self._raw_fn(*args, **kwargs)
+        jitted = self._jitted()
+        if self._layer is not None:
+            state = self._layer.raw_state()
+            out = jitted(state, self._layer.training, args, kwargs)
+            return out
+        return jitted(args, kwargs)
+
+    # reference API surface
+    def concrete_program_specify_input_spec(self, *a, **k):
+        return None
+
+    @property
+    def code(self):
+        import inspect
+
+        return inspect.getsource(self._fn)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
+              **kwargs):
+    """Reference: python/paddle/jit/api.py:197. backend arg accepted for compat (CINN →
+    XLA is always on)."""
+
+    def decorate(fn):
+        return StaticFunction(fn, input_spec, build_strategy, backend)
+
+    if function is None:
+        return decorate
+    return decorate(function)
+
+
+def _call_method(layer, fn, state, args, kwargs):
+    sd = layer.state_dict()
+    saved = {k: t._value for k, t in sd.items()}
+    try:
+        for k, v in state.items():
+            if k in sd:
+                sd[k]._value = v
+        return fn(layer, *args, **kwargs)
+    finally:
+        for k, t in sd.items():
+            t._value = saved[k]
+
+
+class TranslatedLayer(Layer):
+    """Loaded inference layer (reference: translated_layer.py)."""
+
+    def __init__(self, state, meta, forward_fn=None):
+        super().__init__()
+        self._state = state
+        self._meta = meta
+        self._forward_fn = forward_fn
+
+    def forward(self, *args):
+        raise NotImplementedError(
+            "TranslatedLayer from paddle_tpu.jit.load holds weights only; rebuild the "
+            "model class and call set_state_dict — serialized program replay lands with "
+            "the inference runtime."
+        )
+
+
+def save(layer, path, input_spec=None, **configs):
+    """paddle.jit.save: persist weights + structure metadata. Weights as npz (portable,
+    no pickle trust issues for arrays) + a meta pickle for structure."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    if isinstance(layer, StaticFunction):
+        layer = layer.layer
+    state = {k: np.asarray(v._value) for k, v in layer.state_dict().items()}
+    np.savez(path + ".pdiparams.npz", **state)
+    meta = {
+        "class_name": type(layer).__name__,
+        "state_keys": list(state.keys()),
+        "input_spec": None,
+    }
+    with open(path + ".pdmodel.meta", "wb") as f:
+        pickle.dump(meta, f)
+
+
+def load(path, **configs):
+    with open(path + ".pdmodel.meta", "rb") as f:
+        meta = pickle.load(f)
+    data = np.load(path + ".pdiparams.npz")
+    state = {k: Tensor(jax_asarray(data[k])) for k in data.files}
+    return TranslatedLayer(state, meta)
+
+
+def jax_asarray(a):
+    import jax.numpy as jnp
+
+    return jnp.asarray(a)

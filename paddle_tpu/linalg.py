@@ -1,0 +1,9 @@
+"""paddle.linalg namespace. Reference: python/paddle/linalg.py (38 exports)."""
+from .ops.linalg import (  # noqa: F401
+    cholesky, cholesky_solve, cond, corrcoef, cov, det, eig, eigh, eigvals, eigvalsh,
+    householder_product, inverse as inv, lstsq, lu, matmul, matrix_exp, matrix_power,
+    matrix_rank, multi_dot, norm, pinv, qr, slogdet, solve, svd, triangular_solve,
+    vecdot,
+)
+from .ops.linalg import norm as matrix_norm  # noqa: F401
+from .ops.linalg import norm as vector_norm  # noqa: F401

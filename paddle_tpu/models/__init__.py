@@ -1,0 +1,5 @@
+"""Reference training models (SURVEY.md §7.0: the model zoo lives downstream in the
+reference; these are the in-repo baseline-config drivers)."""
+from .gpt import (  # noqa: F401
+    GPTConfig, GPTForCausalLM, GPTModel, gpt3_1p3b, gpt_tiny, llama2_7b,
+)

@@ -1,0 +1,227 @@
+"""GPT-style decoder — the flagship LLM reference model.
+
+Reference: the PaddleNLP GPT/ERNIE model family is OUT of the reference repo
+(SURVEY.md §7.0) — this is the in-repo reference training script target for the
+BASELINE configs 3-5. Built TPU-first:
+- TP via fleet mpu layers (VocabParallelEmbedding / Column/RowParallelLinear) whose
+  weights carry 'mp' shardings — GSPMD inserts ICI collectives.
+- Sequence axis: activations carry a ('dp','sep') batch/seq sharding constraint.
+- Attention is paddle-layout [B, S, H, D] flash_attention (Pallas on long seqs).
+- RoPE + RMSNorm (pre-norm) or learned positions + LayerNorm (GPT-2 style).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..distributed.fleet.meta_parallel import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from ..distributed.mesh import get_mesh
+from ..nn import functional as F
+from ..nn.layer import Layer
+from ..nn.layer_common import Dropout, Embedding, LayerList, Linear
+from ..nn.layer_conv_norm import LayerNorm, RMSNorm
+from ..ops import apply_op
+from ..tensor import Tensor
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12, num_heads=12,
+                 num_kv_heads=None, intermediate_size=None, max_position=2048,
+                 dropout=0.0, use_rope=True, use_rms_norm=True, use_swiglu=True,
+                 tie_embeddings=True, dtype="float32"):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.num_kv_heads = num_kv_heads or num_heads
+        self.intermediate_size = intermediate_size or 4 * hidden_size
+        self.max_position = max_position
+        self.dropout = dropout
+        self.use_rope = use_rope
+        self.use_rms_norm = use_rms_norm
+        self.use_swiglu = use_swiglu
+        self.tie_embeddings = tie_embeddings
+        self.dtype = dtype
+
+
+def _shard_seq(x):
+    """Constrain activations to a ('dp','sep') batch/seq layout when a mesh exists —
+    the sequence-parallel (SEP axis) recipe."""
+    mesh = get_mesh()
+    if mesh is None or not isinstance(x._value, jax.core.Tracer):
+        return x
+    names = mesh.dim_names
+    if "dp" not in names and "sep" not in names:
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    entries = [None] * x.ndim
+    if "dp" in names and mesh.get_dim_size("dp") > 1:
+        entries[0] = "dp"
+    if "sep" in names and x.ndim >= 2 and mesh.get_dim_size("sep") > 1:
+        entries[1] = "sep"
+    x._value = jax.lax.with_sharding_constraint(
+        x._value, NamedSharding(mesh.jax_mesh, PartitionSpec(*entries))
+    )
+    return x
+
+
+class GPTAttention(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        c = config
+        self.num_heads = c.num_heads
+        self.num_kv_heads = c.num_kv_heads
+        self.head_dim = c.hidden_size // c.num_heads
+        self.use_rope = c.use_rope
+        q_size = c.hidden_size
+        kv_size = self.num_kv_heads * self.head_dim
+        self.qkv_proj = ColumnParallelLinear(c.hidden_size, q_size + 2 * kv_size,
+                                             has_bias=not c.use_rms_norm,
+                                             gather_output=False)
+        self.out_proj = RowParallelLinear(c.hidden_size, c.hidden_size,
+                                          has_bias=not c.use_rms_norm,
+                                          input_is_parallel=True)
+        self.dropout = c.dropout
+
+    def forward(self, x, position_ids=None):
+        B, S = x.shape[0], x.shape[1]
+        qkv = self.qkv_proj(x)
+        q_size = self.num_heads * self.head_dim
+        kv_size = self.num_kv_heads * self.head_dim
+
+        def split_qkv(v):
+            q = v[..., :q_size].reshape(B, S, self.num_heads, self.head_dim)
+            k = v[..., q_size:q_size + kv_size].reshape(B, S, self.num_kv_heads,
+                                                        self.head_dim)
+            vv = v[..., q_size + kv_size:].reshape(B, S, self.num_kv_heads,
+                                                   self.head_dim)
+            return q, k, vv
+
+        q, k, v = apply_op(split_qkv, "split_qkv", qkv)
+        if self.use_rope:
+            from ..incubate.nn.functional import fused_rotary_position_embedding
+
+            q, k, _ = fused_rotary_position_embedding(q, k, position_ids=position_ids)
+        out, _ = F.flash_attention(q, k, v, dropout=self.dropout, causal=True,
+                                   training=self.training)
+        out = out.reshape([B, S, q_size])
+        return self.out_proj(out)
+
+
+class GPTMLP(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        c = config
+        self.use_swiglu = c.use_swiglu
+        inner = c.intermediate_size
+        if c.use_swiglu:
+            self.gate_up = ColumnParallelLinear(c.hidden_size, 2 * inner,
+                                                has_bias=False, gather_output=False)
+        else:
+            self.fc1 = ColumnParallelLinear(c.hidden_size, inner, has_bias=True,
+                                            gather_output=False)
+        self.down = RowParallelLinear(inner, c.hidden_size,
+                                      has_bias=not c.use_swiglu,
+                                      input_is_parallel=True)
+
+    def forward(self, x):
+        if self.use_swiglu:
+            from ..incubate.nn.functional import swiglu
+
+            return self.down(swiglu(self.gate_up(x)))
+        return self.down(F.gelu(self.fc1(x)))
+
+
+class GPTBlock(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        c = config
+        Norm = RMSNorm if c.use_rms_norm else LayerNorm
+        self.ln1 = Norm(c.hidden_size)
+        self.attn = GPTAttention(c)
+        self.ln2 = Norm(c.hidden_size)
+        self.mlp = GPTMLP(c)
+        self.dropout = Dropout(c.dropout)
+
+    def forward(self, x, position_ids=None):
+        x = _shard_seq(x)
+        x = x + self.dropout(self.attn(self.ln1(x), position_ids))
+        x = x + self.dropout(self.mlp(self.ln2(x)))
+        return x
+
+
+class GPTModel(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        c = config
+        self.config = c
+        self.embed_tokens = VocabParallelEmbedding(c.vocab_size, c.hidden_size)
+        if not c.use_rope:
+            self.embed_positions = Embedding(c.max_position, c.hidden_size)
+        self.blocks = LayerList([GPTBlock(c) for _ in range(c.num_layers)])
+        Norm = RMSNorm if c.use_rms_norm else LayerNorm
+        self.ln_f = Norm(c.hidden_size)
+        if not c.tie_embeddings:
+            self.lm_head = ColumnParallelLinear(c.hidden_size, c.vocab_size,
+                                                has_bias=False)
+
+    def forward(self, input_ids, position_ids=None):
+        x = self.embed_tokens(input_ids)
+        if not self.config.use_rope:
+            from ..ops.creation import arange
+
+            if position_ids is None:
+                position_ids = arange(input_ids.shape[1])
+            x = x + self.embed_positions(position_ids)
+        x = _shard_seq(x)
+        for blk in self.blocks:
+            x = blk(x, position_ids)
+        x = self.ln_f(x)
+        if self.config.tie_embeddings:
+            logits = apply_op(lambda h, w: h @ w.T, "lm_head_tied", x,
+                              self.embed_tokens.weight)
+        else:
+            logits = self.lm_head(x)
+        return logits
+
+
+class GPTForCausalLM(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.gpt = GPTModel(config)
+        self.config = config
+
+    def forward(self, input_ids, labels=None, position_ids=None):
+        logits = self.gpt(input_ids, position_ids)
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.reshape([-1, logits.shape[-1]]), labels.reshape([-1])
+            )
+            return logits, loss
+        return logits
+
+
+def gpt3_1p3b():
+    """GPT-3 1.3B (BASELINE config 4)."""
+    return GPTConfig(vocab_size=50304, hidden_size=2048, num_layers=24, num_heads=16,
+                     use_rope=False, use_rms_norm=False, use_swiglu=False)
+
+
+def llama2_7b():
+    """LLaMA-2-7B (BASELINE config 5)."""
+    return GPTConfig(vocab_size=32000, hidden_size=4096, num_layers=32, num_heads=32,
+                     num_kv_heads=32, intermediate_size=11008, max_position=4096,
+                     use_rope=True, use_rms_norm=True, use_swiglu=True,
+                     tie_embeddings=False)
+
+
+def gpt_tiny():
+    return GPTConfig(vocab_size=1024, hidden_size=64, num_layers=2, num_heads=4,
+                     max_position=128)

@@ -1,0 +1,36 @@
+"""paddle.nn surface. Reference: python/paddle/nn/__init__.py (141 exports)."""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layer import Layer, ParamAttr, Parameter  # noqa: F401
+from .layer_common import (  # noqa: F401
+    AlphaDropout, Bilinear, CELU, CosineSimilarity, Dropout, Dropout2D, Dropout3D, ELU,
+    Embedding, Flatten, GELU, GLU, Hardshrink, Hardsigmoid, Hardswish, Hardtanh,
+    Identity, LayerDict, LayerList, LeakyReLU, Linear, LogSigmoid, LogSoftmax, Maxout,
+    Mish, Pad1D, Pad2D, Pad3D, ParameterList, PixelShuffle, PReLU, ReLU, ReLU6, RReLU,
+    SELU, Sequential, Sigmoid, Silu, Softmax, Softplus, Softshrink, Softsign, Swish,
+    Tanh, Tanhshrink, ThresholdedReLU, Upsample, UpsamplingBilinear2D,
+    UpsamplingNearest2D, ZeroPad2D,
+)
+from .layer_conv_norm import (  # noqa: F401
+    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D, AdaptiveMaxPool1D,
+    AdaptiveMaxPool2D, AdaptiveMaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D, BatchNorm,
+    BatchNorm1D, BatchNorm2D, BatchNorm3D, Conv1D, Conv1DTranspose, Conv2D,
+    Conv2DTranspose, Conv3D, Conv3DTranspose, GroupNorm, InstanceNorm1D, InstanceNorm2D,
+    InstanceNorm3D, LayerNorm, LocalResponseNorm, MaxPool1D, MaxPool2D, MaxPool3D,
+    RMSNorm, SyncBatchNorm,
+)
+from .layer_loss import (  # noqa: F401
+    BCELoss, BCEWithLogitsLoss, CosineEmbeddingLoss, CrossEntropyLoss, CTCLoss,
+    GaussianNLLLoss, HingeEmbeddingLoss, KLDivLoss, L1Loss, MarginRankingLoss, MSELoss,
+    MultiLabelSoftMarginLoss, NLLLoss, PoissonNLLLoss, SmoothL1Loss, SoftMarginLoss,
+    TripletMarginLoss,
+)
+from .layer_transformer import (  # noqa: F401
+    MultiHeadAttention, Transformer, TransformerDecoder, TransformerDecoderLayer,
+    TransformerEncoder, TransformerEncoderLayer,
+)
+from .layer_rnn import (  # noqa: F401
+    GRU, GRUCell, LSTM, LSTMCell, RNN, SimpleRNN, SimpleRNNCell,
+)
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+from . import utils  # noqa: F401

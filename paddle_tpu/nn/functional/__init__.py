@@ -1,0 +1,21 @@
+"""paddle.nn.functional surface. Reference: python/paddle/nn/functional/__init__.py
+(128 exports)."""
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .flash_attention import (  # noqa: F401
+    flash_attention,
+    flash_attn_unpadded,
+    flashmask_attention,
+    scaled_dot_product_attention,
+    sdp_kernel,
+)
+from .vision import *  # noqa: F401,F403
+from ...ops.manipulation import pad, unfold  # noqa: F401
+from ...ops.creation import one_hot  # noqa: F401
+
+# re-export select ops that paddle exposes under functional too
+from ...ops.math import clip  # noqa: F401

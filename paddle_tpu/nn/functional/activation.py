@@ -1,0 +1,217 @@
+"""Activation functionals. Reference: python/paddle/nn/functional/activation.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops import apply_op
+
+__all__ = [
+    "relu", "relu_", "relu6", "elu", "elu_", "selu", "celu", "gelu", "silu", "swish",
+    "sigmoid", "hardsigmoid", "hardswish", "hardtanh", "hardshrink", "softshrink",
+    "tanhshrink", "leaky_relu", "log_sigmoid", "log_softmax", "softmax", "softmax_",
+    "softplus", "softsign", "mish", "prelu", "rrelu", "maxout", "glu", "gumbel_softmax",
+    "tanh", "thresholded_relu",
+]
+
+
+def relu(x, name=None):
+    return apply_op(jax.nn.relu, "relu", x)
+
+
+def relu_(x, name=None):
+    out = relu(x)
+    x._value, x._grad_node, x._grad_index = out._value, out._grad_node, out._grad_index
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def relu6(x, name=None):
+    return apply_op(jax.nn.relu6, "relu6", x)
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply_op(lambda v: jax.nn.elu(v, alpha=alpha), "elu", x)
+
+
+def elu_(x, alpha=1.0, name=None):
+    out = elu(x, alpha)
+    x._value = out._value
+    return x
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply_op(
+        lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)), "selu", x
+    )
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply_op(lambda v: jax.nn.celu(v, alpha=alpha), "celu", x)
+
+
+def gelu(x, approximate=False, name=None):
+    return apply_op(lambda v: jax.nn.gelu(v, approximate=approximate), "gelu", x)
+
+
+def silu(x, name=None):
+    return apply_op(jax.nn.silu, "silu", x)
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def sigmoid(x, name=None):
+    return apply_op(jax.nn.sigmoid, "sigmoid", x)
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply_op(lambda v: jnp.clip(slope * v + offset, 0.0, 1.0), "hardsigmoid", x)
+
+
+def hardswish(x, name=None):
+    return apply_op(lambda v: v * jnp.clip(v + 3.0, 0.0, 6.0) / 6.0, "hardswish", x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply_op(lambda v: jnp.clip(v, min, max), "hardtanh", x)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply_op(
+        lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0).astype(v.dtype), "hardshrink", x
+    )
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply_op(
+        lambda v: jnp.where(v > threshold, v - threshold,
+                            jnp.where(v < -threshold, v + threshold, 0.0)).astype(v.dtype),
+        "softshrink", x,
+    )
+
+
+def tanhshrink(x, name=None):
+    return apply_op(lambda v: v - jnp.tanh(v), "tanhshrink", x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply_op(lambda v: jax.nn.leaky_relu(v, negative_slope), "leaky_relu", x)
+
+
+def log_sigmoid(x, name=None):
+    return apply_op(jax.nn.log_sigmoid, "log_sigmoid", x)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def f(v):
+        if dtype is not None:
+            from ...framework import dtype as _dt
+
+            v = v.astype(_dt.convert_dtype(dtype))
+        return jax.nn.log_softmax(v, axis=axis)
+
+    return apply_op(f, "log_softmax", x)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def f(v):
+        if dtype is not None:
+            from ...framework import dtype as _dt
+
+            v = v.astype(_dt.convert_dtype(dtype))
+        return jax.nn.softmax(v, axis=axis)
+
+    return apply_op(f, "softmax", x)
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    out = softmax(x, axis, dtype)
+    x._value = out._value
+    return x
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply_op(
+        lambda v: jnp.where(beta * v > threshold, v, jnp.log1p(jnp.exp(beta * v)) / beta),
+        "softplus", x,
+    )
+
+
+def softsign(x, name=None):
+    return apply_op(jax.nn.soft_sign, "softsign", x)
+
+
+def mish(x, name=None):
+    return apply_op(lambda v: v * jnp.tanh(jax.nn.softplus(v)), "mish", x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(v, w):
+        if w.size == 1:
+            wb = w.reshape(())
+        else:
+            # per-channel: broadcast along the channel axis
+            nd = v.ndim
+            ch_axis = 1 if data_format.startswith("NC") and nd > 1 else nd - 1
+            shape = [1] * nd
+            shape[ch_axis] = w.size
+            wb = w.reshape(shape)
+        return jnp.where(v > 0, v, wb * v)
+
+    return apply_op(f, "prelu", x, weight)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
+    from ...framework import random as _rng
+
+    if training:
+        def f(v):
+            a = jax.random.uniform(_rng.next_key(), v.shape, dtype=jnp.float32,
+                                   minval=lower, maxval=upper).astype(v.dtype)
+            return jnp.where(v >= 0, v, a * v)
+
+        return apply_op(f, "rrelu", x)
+    mid = (lower + upper) / 2.0
+    return apply_op(lambda v: jnp.where(v >= 0, v, mid * v), "rrelu", x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(v):
+        ax = axis % v.ndim
+        c = v.shape[ax]
+        new_shape = list(v.shape[:ax]) + [c // groups, groups] + list(v.shape[ax + 1:])
+        return jnp.max(v.reshape(new_shape), axis=ax + 1)
+
+    return apply_op(f, "maxout", x)
+
+
+def glu(x, axis=-1, name=None):
+    return apply_op(lambda v: jax.nn.glu(v, axis=axis), "glu", x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework import random as _rng
+
+    def f(v):
+        g = jax.random.gumbel(_rng.next_key(), v.shape, dtype=v.dtype)
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            hard_y = jnp.zeros_like(y)
+            hard_y = jnp.put_along_axis(hard_y, idx, 1.0, axis=axis, inplace=False)
+            y = hard_y + y - jax.lax.stop_gradient(y)
+        return y
+
+    return apply_op(f, "gumbel_softmax", x)
+
+
+def tanh(x, name=None):
+    return apply_op(jnp.tanh, "tanh", x)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply_op(
+        lambda v: jnp.where(v > threshold, v, value).astype(v.dtype), "thresholded_relu", x
+    )

@@ -1,0 +1,267 @@
+"""Common functionals: linear, dropout, embedding, interpolate, one_hot…
+Reference: python/paddle/nn/functional/common.py, input.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework import dtype as _dt
+from ...framework import random as _rng
+from ...ops import apply_op
+from ...ops.manipulation import pad  # noqa: F401 (re-export)
+from ...tensor import Tensor
+
+__all__ = [
+    "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout", "embedding",
+    "one_hot", "label_smooth", "pad", "interpolate", "upsample", "bilinear", "cosine_similarity",
+    "pixel_shuffle", "pixel_unshuffle", "channel_shuffle", "fold", "unfold", "zeropad2d",
+]
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b. W layout [in, out] (paddle layout) — one MXU matmul."""
+    if bias is None:
+        return apply_op(lambda v, w: v @ w, "linear", x, weight)
+    return apply_op(lambda v, w, b: v @ w + b, "linear", x, weight, bias)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or (isinstance(p, (int, float)) and p == 0):
+        return x if isinstance(x, Tensor) else Tensor(x)
+    pv = float(p)
+
+    def f(v):
+        shape = list(v.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(_rng.next_key(), 1.0 - pv, shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - pv), 0.0).astype(v.dtype)
+        return jnp.where(keep, v, 0.0).astype(v.dtype)
+
+    return apply_op(f, "dropout", x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=ax, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ax = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=ax, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def f(v):
+        keep = jax.random.bernoulli(_rng.next_key(), 1.0 - p, v.shape)
+        a = (1.0 / ((1 - p) * (1 + p * alpha_p**2)) ** 0.5)
+        b = -a * alpha_p * p
+        return (a * jnp.where(keep, v, alpha_p) + b).astype(v.dtype)
+
+    return apply_op(f, "alpha_dropout", x)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def f(idx, w):
+        out = jnp.take(w, idx.astype(jnp.int32), axis=0)
+        if padding_idx is not None:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+
+    return apply_op(f, "embedding", x, weight)
+
+
+def one_hot(x, num_classes, name=None):
+    from ...ops.creation import one_hot as _oh
+
+    return _oh(x, num_classes)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(lv, pd):
+        k = lv.shape[-1]
+        if pd is None:
+            return (1 - epsilon) * lv + epsilon / k
+        return (1 - epsilon) * lv + epsilon * pd
+
+    return apply_op(f, "label_smooth", label, prior_dist)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def f(a, b, w, bi):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bi is not None:
+            out = out + bi
+        return out
+
+    return apply_op(f, "bilinear", x1, x2, weight, bias)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def f(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+
+    return apply_op(f, "cosine_similarity", x1, x2)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+                align_mode=0, data_format="NCHW", name=None):
+    """Resize via jax.image.resize. Supports nearest/bilinear/bicubic/trilinear/area."""
+    mode = mode.lower()
+
+    def f(v):
+        chan_last = data_format.endswith("C")
+        nd = v.ndim
+        spatial = list(range(1, nd - 1)) if chan_last else list(range(2, nd))
+        in_sizes = [v.shape[d] for d in spatial]
+        if size is not None:
+            sizes = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in (
+                size if isinstance(size, (list, tuple)) else [size]
+            )]
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * len(spatial)
+            sizes = [int(round(i * float(s))) for i, s in zip(in_sizes, sf)]
+        out_shape = list(v.shape)
+        for d, s in zip(spatial, sizes):
+            out_shape[d] = s
+        method = {
+            "nearest": "nearest",
+            "bilinear": "bilinear",
+            "bicubic": "bicubic",
+            "trilinear": "trilinear",
+            "linear": "linear",
+            "area": "linear",
+        }[mode]
+        if mode == "nearest":
+            return jax.image.resize(v, out_shape, method="nearest")
+        if align_corners and all(s > 1 for s in sizes):
+            # align_corners resize: sample at exact corner-aligned coordinates
+            idx = []
+            vv = v
+            for d, s in zip(spatial, sizes):
+                in_s = v.shape[d]
+                coords = jnp.linspace(0.0, in_s - 1, s)
+                lo = jnp.floor(coords).astype(jnp.int32)
+                hi = jnp.minimum(lo + 1, in_s - 1)
+                w = (coords - lo).astype(v.dtype)
+                lo_t = jnp.take(vv, lo, axis=d)
+                hi_t = jnp.take(vv, hi, axis=d)
+                bshape = [1] * nd
+                bshape[d] = s
+                w = w.reshape(bshape)
+                vv = lo_t * (1 - w) + hi_t * w
+            return vv
+        return jax.image.resize(v, out_shape, method=method)
+
+    return apply_op(f, "interpolate", x)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c // (r * r), r, r, h, w)
+            v = v.transpose(0, 1, 4, 2, 5, 3)
+            return v.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, r, r, c // (r * r))
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(n, h * r, w * r, c // (r * r))
+
+    return apply_op(f, "pixel_shuffle", x)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c, h // r, r, w // r, r)
+            v = v.transpose(0, 1, 3, 5, 2, 4)
+            return v.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h // r, r, w // r, r, c)
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(n, h // r, w // r, c * r * r)
+
+    return apply_op(f, "pixel_unshuffle", x)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, groups, c // groups, h, w)
+            return v.transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, groups, c // groups)
+        return v.transpose(0, 1, 2, 4, 3).reshape(n, h, w, c)
+
+    return apply_op(f, "channel_shuffle", x)
+
+
+from ...ops.manipulation import unfold  # noqa: F401,E402
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """col2im — adjoint of unfold; implemented as the VJP of unfold (XLA fuses it)."""
+    oh, ow = (output_sizes, output_sizes) if isinstance(output_sizes, int) else output_sizes
+    kh, kw = (kernel_sizes, kernel_sizes) if isinstance(kernel_sizes, int) else kernel_sizes
+
+    def f(v):
+        n, ckk, L = v.shape
+        c = ckk // (kh * kw)
+
+        def unfold_fn(img):
+            from ...ops.manipulation import unfold as _unf
+
+            sh = strides if isinstance(strides, int) else strides[0]
+            # build raw jax unfold for vjp
+            import jax.lax as lax
+
+            sh, sw = (strides, strides) if isinstance(strides, int) else strides
+            dh, dw = (dilations, dilations) if isinstance(dilations, int) else dilations
+            if isinstance(paddings, int):
+                pt = pb = pl = pr = paddings
+            elif len(paddings) == 2:
+                pt = pb = paddings[0]
+                pl = pr = paddings[1]
+            else:
+                pt, pl, pb, pr = paddings
+            imgp = jnp.pad(img, ((0, 0), (0, 0), (pt, pb), (pl, pr)))
+            patches = lax.conv_general_dilated_patches(
+                imgp, (kh, kw), (sh, sw), "VALID", rhs_dilation=(dh, dw),
+                dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            )
+            return patches.reshape(img.shape[0], c * kh * kw, -1)
+
+        zeros = jnp.zeros((n, c, oh, ow), v.dtype)
+        _, vjp = jax.vjp(unfold_fn, zeros)
+        (out,) = vjp(v)
+        return out
+
+    return apply_op(f, "fold", x)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)

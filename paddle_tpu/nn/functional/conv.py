@@ -1,0 +1,169 @@
+"""Convolutions over lax.conv_general_dilated — XLA tiles these onto the MXU.
+Reference: python/paddle/nn/functional/conv.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops import apply_op
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose",
+           "conv3d_transpose"]
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _pad_arg(padding, n, strides=None, dilations=None, ksize=None):
+    """Normalize paddle padding spec to lax format: 'SAME'/'VALID'/explicit pairs."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    # nested pairs
+    return [tuple(p) for p in padding]
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format):
+    strides = _tuple(stride, n)
+    dilations = _tuple(dilation, n)
+    chan_last = data_format.endswith("C")
+    if n == 1:
+        dn = ("NWC", "WIO", "NWC") if chan_last else ("NCW", "OIW", "NCW")
+    elif n == 2:
+        dn = ("NHWC", "HWIO", "NHWC") if chan_last else ("NCHW", "OIHW", "NCHW")
+    else:
+        dn = ("NDHWC", "DHWIO", "NDHWC") if chan_last else ("NCDHW", "OIDHW", "NCDHW")
+    pad = _pad_arg(padding, n)
+
+    def f(v, w, b):
+        # paddle weight layout is always [out_c, in_c/groups, *k]; convert if chan_last
+        if chan_last:
+            # OIHW → HWIO
+            perm = list(range(2, 2 + n)) + [1, 0]
+            w = jnp.transpose(w, perm)
+        out = jax.lax.conv_general_dilated(
+            v, w, window_strides=strides, padding=pad, rhs_dilation=dilations,
+            dimension_numbers=dn, feature_group_count=groups,
+            preferred_element_type=None,
+        )
+        if b is not None:
+            if chan_last:
+                out = out + b
+            else:
+                out = out + b.reshape((1, -1) + (1,) * n)
+        return out
+
+    return apply_op(f, f"conv{n}d", x, weight, bias)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    fmt = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, fmt)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups,
+                    n, data_format, output_size):
+    strides = _tuple(stride, n)
+    dilations = _tuple(dilation, n)
+    chan_last = data_format.endswith("C")
+    opad = _tuple(output_padding, n) if output_padding is not None else (0,) * n
+    if isinstance(padding, str):
+        pads = None
+        same = padding.upper() == "SAME"
+    else:
+        p = _pad_arg(padding, n)
+        pads = p if isinstance(p, list) else [(0, 0)] * n
+        same = False
+
+    def f(v, w, b):
+        # paddle transpose-conv weight layout: [in_c, out_c/groups, *k]
+        # Use conv_transpose via gradient trick: lax.conv_transpose expects IO spatial.
+        if chan_last:
+            v_ncx = jnp.moveaxis(v, -1, 1)
+        else:
+            v_ncx = v
+        in_c = v_ncx.shape[1]
+        out_c = w.shape[1] * groups
+        # lax.conv_general_dilated with lhs_dilation implements transposed conv
+        k = w.shape[2:]
+        if pads is None:
+            if same:
+                pad_list = []
+                for i in range(n):
+                    eff_k = (k[i] - 1) * dilations[i] + 1
+                    total = max(eff_k - strides[i], 0)
+                    pad_list.append((total // 2, total - total // 2))
+            else:
+                pad_list = [(0, 0)] * n
+        else:
+            pad_list = pads
+        # transposed conv: flip kernel, swap in/out, dilate input by stride
+        # weight [in, out/g, *k] → conv weight [out, in/g, *k] with flipped spatial
+        wt = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+        if groups > 1:
+            # [in, out/g, *k] → grouped: split in into g groups
+            wt = wt.reshape((groups, in_c // groups) + wt.shape[1:])
+            wt = jnp.moveaxis(wt, 2, 1)  # [g, out/g, in/g, *k]
+            wt = wt.reshape((out_c, in_c // groups) + k)
+        else:
+            wt = jnp.swapaxes(wt, 0, 1)  # [out, in, *k]
+        conv_pads = []
+        for i in range(n):
+            eff_k = (k[i] - 1) * dilations[i] + 1
+            lo = eff_k - 1 - pad_list[i][0]
+            hi = eff_k - 1 - pad_list[i][1] + opad[i]
+            conv_pads.append((lo, hi))
+        dn = {1: ("NCW", "OIW", "NCW"), 2: ("NCHW", "OIHW", "NCHW"),
+              3: ("NCDHW", "OIDHW", "NCDHW")}[n]
+        out = jax.lax.conv_general_dilated(
+            v_ncx, wt, window_strides=(1,) * n, padding=conv_pads,
+            lhs_dilation=strides, rhs_dilation=dilations, dimension_numbers=dn,
+            feature_group_count=groups,
+        )
+        if b is not None:
+            out = out + b.reshape((1, -1) + (1,) * n)
+        if chan_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return apply_op(f, f"conv{n}d_transpose", x, weight, bias)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    fmt = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                           groups, 1, fmt, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, data_format="NCHW", output_size=None, name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                           groups, 2, data_format, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, data_format="NCDHW", output_size=None, name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                           groups, 3, data_format, output_size)

@@ -1,0 +1,202 @@
+"""Attention functionals.
+
+Reference parity: python/paddle/nn/functional/flash_attention.py:358 (flash_attention),
+:1299 (flashmask_attention), scaled_dot_product_attention, sdp_kernel selector (:144).
+TPU-native: the default path is a fused XLA softmax(QK^T)V (jnp ops fused by XLA); a
+Pallas flash kernel (paddle_tpu/ops/pallas/flash_attention.py) is used on TPU for long
+sequences where HBM-resident scores would dominate.
+"""
+from __future__ import annotations
+
+import contextlib
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...ops import apply_op
+from ...tensor import Tensor
+
+__all__ = [
+    "flash_attention", "flash_attn_unpadded", "flashmask_attention",
+    "scaled_dot_product_attention", "sdp_kernel",
+]
+
+_sdp_config = {"enable_flash": True, "enable_math": True, "enable_mem_efficient": True}
+
+
+@contextlib.contextmanager
+def sdp_kernel(enable_flash=True, enable_math=True, enable_mem_efficient=True):
+    prev = dict(_sdp_config)
+    _sdp_config.update(
+        enable_flash=enable_flash, enable_math=enable_math,
+        enable_mem_efficient=enable_mem_efficient,
+    )
+    try:
+        yield
+    finally:
+        _sdp_config.update(prev)
+
+
+def _use_pallas(q_shape, dtype) -> bool:
+    if not _sdp_config["enable_flash"]:
+        return False
+    try:
+        dev = jax.devices()[0].platform
+    except Exception:
+        return False
+    if dev in ("cpu", "gpu"):
+        return False
+    seq = q_shape[1]
+    # pallas pays off when the score matrix stops fitting in VMEM
+    return seq >= 1024 and seq % 128 == 0
+
+
+def _sdpa_core(q, k, v, mask, scale, is_causal, dropout_p, training):
+    """q/k/v: [B, S, H, D] (paddle flash_attention layout)."""
+    qh = jnp.swapaxes(q, 1, 2)  # [B,H,S,D]
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    # grouped-query: broadcast kv heads
+    if kh.shape[1] != qh.shape[1]:
+        rep = qh.shape[1] // kh.shape[1]
+        kh = jnp.repeat(kh, rep, axis=1)
+        vh = jnp.repeat(vh, rep, axis=1)
+    scores = jnp.einsum("bhsd,bhtd->bhst", qh, kh) * scale
+    if is_causal:
+        s, t = scores.shape[-2], scores.shape[-1]
+        causal = jnp.tril(jnp.ones((s, t), bool), k=t - s)
+        scores = jnp.where(causal, scores, jnp.finfo(scores.dtype).min)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+        else:
+            scores = scores + mask
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_p and training:
+        from ...framework import random as _rng
+
+        keep = jax.random.bernoulli(_rng.next_key(), 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0).astype(probs.dtype)
+    out = jnp.einsum("bhst,bhtd->bhsd", probs, vh)
+    return jnp.swapaxes(out, 1, 2)  # back to [B,S,H,D]
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False,
+                    fixed_seed_offset=None, rng_name="", training=True, name=None):
+    """Reference: flash_attention.py:358. Layout [batch, seq, heads, head_dim]."""
+    head_dim = query.shape[-1]
+    scale = 1.0 / math.sqrt(head_dim)
+
+    if _use_pallas(tuple(query.shape), query.dtype) and not dropout:
+        from ...ops.pallas.flash_attention import flash_attention_fwd
+
+        out = apply_op(
+            lambda q, k, v: flash_attention_fwd(q, k, v, causal=causal, scale=scale),
+            "flash_attention_pallas", query, key, value,
+        )
+        return (out, None) if return_softmax else (out, None)
+
+    out = apply_op(
+        lambda q, k, v: _sdpa_core(q, k, v, None, scale, causal, dropout, training),
+        "flash_attention", query, key, value,
+    )
+    return out, None
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
+                        max_seqlen_k, scale, dropout=0.0, causal=False,
+                        return_softmax=False, fixed_seed_offset=None, rng_name="",
+                        training=True, name=None):
+    """Varlen attention (reference :756): tokens packed as [total, heads, dim] with
+    cu_seqlens boundaries. TPU-native: segment-mask over one padded batch — static
+    shapes, no dynamic slicing."""
+
+    def f(q, k, v, cu_q, cu_k):
+        total_q = q.shape[0]
+        seg_q = jnp.cumsum(
+            jnp.zeros(total_q, jnp.int32).at[cu_q[1:-1].astype(jnp.int32)].add(1)
+        )
+        total_k = k.shape[0]
+        seg_k = jnp.cumsum(
+            jnp.zeros(total_k, jnp.int32).at[cu_k[1:-1].astype(jnp.int32)].add(1)
+        )
+        scores = jnp.einsum("qhd,khd->hqk", q, k) * scale
+        seg_mask = seg_q[:, None] == seg_k[None, :]
+        if causal:
+            pos_q = jnp.arange(total_q) - jnp.take(cu_q, seg_q)
+            pos_k = jnp.arange(total_k) - jnp.take(cu_k, seg_k)
+            seg_mask = seg_mask & (pos_q[:, None] >= pos_k[None, :])
+        scores = jnp.where(seg_mask[None], scores, jnp.finfo(scores.dtype).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+        return jnp.einsum("hqk,khd->qhd", probs, v)
+
+    out = apply_op(f, "flash_attn_unpadded", query, key, value, cu_seqlens_q, cu_seqlens_k)
+    return out, None
+
+
+def flashmask_attention(query, key, value, startend_row_indices=None, dropout=0.0,
+                        causal=False, window_size=None, return_softmax_lse=False,
+                        return_seed_offset=False, fixed_seed_offset=None, rng_name="",
+                        training=True, name=None):
+    """Reference: flash_attention.py:1299. startend_row_indices [B, H|1, S, {1,2,4}]
+    encodes per-column sparse masks (causal doc masks etc.) — here materialized as a
+    boolean mask; a Pallas blockwise-skip kernel is the optimization path."""
+    head_dim = query.shape[-1]
+    scale = 1.0 / math.sqrt(head_dim)
+
+    def f(q, k, v, sri):
+        B, S = q.shape[0], q.shape[1]
+        T = k.shape[1]
+        rows = jnp.arange(S)[:, None]  # query row index
+        if sri is None:
+            mask = None
+        else:
+            sri_i = sri.astype(jnp.int32)  # [B, H', T, n]
+            n = sri_i.shape[-1]
+            cols = jnp.arange(T)[None, None, None, :]
+            if causal:
+                if n == 1:
+                    # LT start: mask rows >= start (below start) for each column
+                    start = jnp.moveaxis(sri_i, -1, 0)[0]  # [B,H',T]
+                    masked = rows[None, None, :, :] * 0  # broadcast helper
+                    m = rows[None, None] >= start[:, :, None, :]
+                else:
+                    start = sri_i[..., 0]
+                    end = sri_i[..., 1]
+                    m = (rows[None, None] >= start[:, :, None, :]) & (
+                        rows[None, None] < end[:, :, None, :]
+                    )
+                causal_m = rows >= jnp.arange(T)[None, :]
+                mask = (~m) & causal_m[None, None]
+            else:
+                # [LTS, LTE, UTS, UTE]
+                lts = sri_i[..., 0]
+                lte = sri_i[..., 1] if n > 1 else jnp.full_like(lts, S)
+                uts = sri_i[..., 2] if n > 2 else jnp.zeros_like(lts)
+                ute = sri_i[..., 3] if n > 3 else jnp.zeros_like(lts)
+                lower = (rows[None, None] >= lts[:, :, None, :]) & (
+                    rows[None, None] < lte[:, :, None, :]
+                )
+                upper = (rows[None, None] >= uts[:, :, None, :]) & (
+                    rows[None, None] < ute[:, :, None, :]
+                )
+                mask = ~(lower | upper)
+        return _sdpa_core(q, k, v, mask, scale, causal and sri is None, dropout, training)
+
+    out = apply_op(f, "flashmask_attention", query, key, value, startend_row_indices)
+    if return_softmax_lse or return_seed_offset:
+        extras = [None] * (int(return_softmax_lse) + int(return_seed_offset))
+        return (out, *extras)
+    return out
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None):
+    """Reference: paddle.nn.functional.scaled_dot_product_attention — [B,S,H,D] layout."""
+    head_dim = query.shape[-1]
+    scale = 1.0 / math.sqrt(head_dim)
+    return apply_op(
+        lambda q, k, v, m: _sdpa_core(q, k, v, m, scale, is_causal, dropout_p, training),
+        "scaled_dot_product_attention", query, key, value, attn_mask,
+    )

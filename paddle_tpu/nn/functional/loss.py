@@ -1,0 +1,326 @@
+"""Loss functionals. Reference: python/paddle/nn/functional/loss.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework import dtype as _dt
+from ...ops import apply_op
+from ...tensor import Tensor
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "mse_loss", "l1_loss", "nll_loss", "kl_div",
+    "smooth_l1_loss", "margin_ranking_loss", "hinge_embedding_loss", "cosine_embedding_loss",
+    "triplet_margin_loss", "triplet_margin_with_distance_loss", "multi_label_soft_margin_loss",
+    "soft_margin_loss", "sigmoid_focal_loss", "square_error_cost", "ctc_loss", "poisson_nll_loss",
+    "gaussian_nll_loss", "log_loss",
+]
+
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    def f(logits, lbl, w):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(logits, 1e-30))
+        n_classes = logits.shape[axis]
+        if soft_label or (lbl.ndim == logits.ndim and lbl.shape == logits.shape):
+            soft = lbl
+            if label_smoothing > 0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / n_classes
+            loss = -jnp.sum(soft * logp, axis=axis)
+            mask = None
+        else:
+            lbl_i = lbl.astype(jnp.int32)
+            if lbl_i.ndim == logits.ndim:
+                lbl_i = jnp.squeeze(lbl_i, axis=axis)
+            mask = lbl_i != ignore_index
+            safe = jnp.where(mask, lbl_i, 0)
+            picked = jnp.take_along_axis(
+                jnp.moveaxis(logp, axis, -1), safe[..., None], axis=-1
+            )[..., 0]
+            if label_smoothing > 0:
+                smooth_loss = -jnp.mean(logp, axis=axis)
+                loss = -(1 - label_smoothing) * picked + label_smoothing * smooth_loss
+            else:
+                loss = -picked
+            if w is not None:
+                loss = loss * jnp.take(w, safe, axis=0)
+            loss = jnp.where(mask, loss, 0.0)
+        if reduction == "mean":
+            if mask is not None:
+                if w is not None:
+                    denom = jnp.sum(jnp.where(mask, jnp.take(w, jnp.where(mask, lbl.astype(jnp.int32) if lbl.ndim != logits.ndim else jnp.squeeze(lbl.astype(jnp.int32), axis=axis), 0), axis=0), 0.0))
+                else:
+                    denom = jnp.maximum(jnp.sum(mask.astype(loss.dtype)), 1.0)
+                return jnp.sum(loss) / denom
+            return jnp.mean(loss)
+        return _reduce(loss, reduction)
+
+    return apply_op(f, "cross_entropy", input, label, weight)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index,
+                         reduction="none", axis=axis)
+    # paddle keeps the reduced axis
+    from ...ops.manipulation import unsqueeze
+
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        from .activation import softmax as _softmax
+
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def f(p, y, w):
+        eps = 1e-12
+        loss = -(y * jnp.log(jnp.maximum(p, eps)) + (1 - y) * jnp.log(jnp.maximum(1 - p, eps)))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    return apply_op(f, "binary_cross_entropy", input, label, weight)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def f(z, y, w, pw):
+        base = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        if pw is not None:
+            log_sig = jax.nn.log_sigmoid(z)
+            log_one_minus = jax.nn.log_sigmoid(-z)
+            base = -(pw * y * log_sig + (1 - y) * log_one_minus)
+        if w is not None:
+            base = base * w
+        return _reduce(base, reduction)
+
+    return apply_op(f, "bce_with_logits", logit, label, weight, pos_weight)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply_op(lambda a, b: _reduce(jnp.square(a - b), reduction), "mse_loss",
+                    input, label)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply_op(lambda a, b: _reduce(jnp.abs(a - b), reduction), "l1_loss",
+                    input, label)
+
+
+def square_error_cost(input, label):
+    return apply_op(lambda a, b: jnp.square(a - b), "square_error_cost", input, label)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return apply_op(
+        lambda p, y: -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon),
+        "log_loss", input, label,
+    )
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    def f(logp, y, w):
+        y = y.astype(jnp.int32)
+        mask = y != ignore_index
+        safe = jnp.where(mask, y, 0)
+        if logp.ndim > 2:
+            # [N, C, d1...] → move C last
+            lp = jnp.moveaxis(logp, 1, -1)
+        else:
+            lp = logp
+        picked = jnp.take_along_axis(lp, safe[..., None], axis=-1)[..., 0]
+        loss = -picked
+        if w is not None:
+            wt = jnp.take(w, safe, axis=0)
+            loss = loss * wt
+        loss = jnp.where(mask, loss, 0.0)
+        if reduction == "mean":
+            denom = jnp.sum(jnp.take(w, safe) * mask) if w is not None else jnp.maximum(
+                jnp.sum(mask.astype(loss.dtype)), 1.0
+            )
+            return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+
+    return apply_op(f, "nll_loss", input, label, weight)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def f(logq, p):
+        if log_target:
+            loss = jnp.exp(p) * (p - logq)
+        else:
+            loss = p * (jnp.log(jnp.maximum(p, 1e-30)) - logq)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logq.shape[0]
+        return _reduce(loss, reduction)
+
+    return apply_op(f, "kl_div", input, label)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+
+    return apply_op(f, "smooth_l1_loss", input, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    return apply_op(
+        lambda a, b, y: _reduce(jnp.maximum(-y * (a - b) + margin, 0.0), reduction),
+        "margin_ranking_loss", input, other, label,
+    )
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return apply_op(
+        lambda x, y: _reduce(
+            jnp.where(y == 1, x, jnp.maximum(margin - x, 0.0)), reduction
+        ),
+        "hinge_embedding_loss", input, label,
+    )
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def f(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12
+        )
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(cos - margin, 0.0))
+        return _reduce(loss, reduction)
+
+    return apply_op(f, "cosine_embedding_loss", input1, input2, label)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6,
+                        swap=False, reduction="mean", name=None):
+    def f(a, pos, neg):
+        def dist(u, v):
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(u - v) + epsilon, p), axis=-1), 1.0 / p)
+
+        dp = dist(a, pos)
+        dn = dist(a, neg)
+        if swap:
+            dn = jnp.minimum(dn, dist(pos, neg))
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return apply_op(f, "triplet_margin_loss", input, positive, negative)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative, distance_function=None,
+                                      margin=1.0, swap=False, reduction="mean", name=None):
+    if distance_function is None:
+        return triplet_margin_loss(input, positive, negative, margin=margin, swap=swap,
+                                   reduction=reduction)
+    dp = distance_function(input, positive)
+    dn = distance_function(input, negative)
+    if swap:
+        pn = distance_function(positive, negative)
+        from ...ops.math import minimum
+
+        dn = minimum(dn, pn)
+    from ...ops.math import maximum as _max
+
+    diff = dp - dn + margin
+    zero = Tensor(jnp.zeros_like(diff._value))
+    loss = _max(diff, zero)
+    return apply_op(lambda v: _reduce(v, reduction), "triplet_reduce", loss)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean", name=None):
+    def f(x, y, w):
+        loss = -(y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x))
+        loss = jnp.mean(loss, axis=-1)
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    return apply_op(f, "multi_label_soft_margin_loss", input, label, weight)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    return apply_op(
+        lambda x, y: _reduce(jnp.log1p(jnp.exp(-y * x)), reduction),
+        "soft_margin_loss", input, label,
+    )
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def f(z, y, nrm):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if nrm is not None:
+            loss = loss / nrm
+        return _reduce(loss, reduction)
+
+    return apply_op(f, "sigmoid_focal_loss", logit, label, normalizer)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def f(x, y):
+        if log_input:
+            loss = jnp.exp(x) - y * x
+        else:
+            loss = x - y * jnp.log(x + epsilon)
+        if full:
+            stirling = y * jnp.log(y + epsilon) - y + 0.5 * jnp.log(2 * jnp.pi * (y + epsilon))
+            loss = loss + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+
+    return apply_op(f, "poisson_nll_loss", input, label)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def f(mu, y, var):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + jnp.square(y - mu) / var)
+        if full:
+            loss = loss + 0.5 * jnp.log(2 * jnp.pi)
+        return _reduce(loss, reduction)
+
+    return apply_op(f, "gaussian_nll_loss", input, label, variance)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via optax if available; else a lax.scan forward algorithm."""
+    import optax
+
+    def f(lp, lbl, il, ll):
+        # optax expects [B, T, C] logits and paddings
+        logits = jnp.transpose(lp, (1, 0, 2)) if lp.ndim == 3 else lp  # paddle gives [T,B,C]
+        B, T, C = logits.shape
+        t_idx = jnp.arange(T)[None, :]
+        logit_pad = (t_idx >= il[:, None]).astype(jnp.float32)
+        L = lbl.shape[1]
+        l_idx = jnp.arange(L)[None, :]
+        label_pad = (l_idx >= ll[:, None]).astype(jnp.float32)
+        per_seq = optax.ctc_loss(logits, logit_pad, lbl.astype(jnp.int32), label_pad,
+                                 blank_id=blank)
+        if reduction == "mean":
+            return jnp.mean(per_seq / jnp.maximum(ll.astype(per_seq.dtype), 1.0))
+        return _reduce(per_seq, reduction)
+
+    return apply_op(f, "ctc_loss", log_probs, labels, input_lengths, label_lengths)

@@ -1,0 +1,187 @@
+"""Normalization functionals. Reference: python/paddle/nn/functional/norm.py."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...ops import apply_op
+from ...tensor import Tensor
+
+__all__ = ["normalize", "batch_norm", "layer_norm", "instance_norm", "group_norm",
+           "local_response_norm", "rms_norm"]
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(v):
+        if p == 2:
+            n = jnp.sqrt(jnp.sum(jnp.square(v), axis=axis, keepdims=True))
+        else:
+            n = jnp.power(jnp.sum(jnp.power(jnp.abs(v), p), axis=axis, keepdims=True), 1.0 / p)
+        return v / jnp.maximum(n, epsilon)
+
+    return apply_op(f, "normalize", x)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-5, data_format="NCHW", use_global_stats=None,
+               name=None):
+    """Training mode updates running stats in place on the passed tensors (paddle
+    semantics: running stats are buffers mutated by the op)."""
+    chan_last = data_format.endswith("C") and data_format not in ("NC", "NCL")
+    use_batch_stats = training and not use_global_stats
+
+    def stats_axes(v):
+        if v.ndim == 2:
+            return (0,), (1, -1)
+        if chan_last:
+            return tuple(range(v.ndim - 1)), (1,) * (v.ndim - 1) + (-1,)
+        return (0,) + tuple(range(2, v.ndim)), (1, -1) + (1,) * (v.ndim - 2)
+
+    if use_batch_stats:
+        ax, bshape = stats_axes(x._value if isinstance(x, Tensor) else x)
+        # batch stats computed inside the graph (differentiable)
+        def f(v, w, b):
+            # stats in fp32 (AMP-safe), output in the input dtype
+            v32 = v.astype(jnp.float32)
+            mean = jnp.mean(v32, axis=ax)
+            var = jnp.var(v32, axis=ax)
+            inv = jnp.reciprocal(jnp.sqrt(var + epsilon))
+            out = (v32 - mean.reshape(bshape)) * inv.reshape(bshape)
+            if w is not None:
+                out = out * w.reshape(bshape).astype(jnp.float32)
+            if b is not None:
+                out = out + b.reshape(bshape).astype(jnp.float32)
+            return out.astype(v.dtype), mean, var
+
+        out, mean_t, var_t = apply_op(f, "batch_norm", x, weight, bias, nout=3)
+        # update running stats (no_grad side effect)
+        if running_mean is not None:
+            running_mean._value = (
+                momentum * running_mean._value + (1 - momentum) * mean_t._value
+            ).astype(running_mean._value.dtype)
+        if running_var is not None:
+            n = 1
+            v = x._value
+            for a in stats_axes(v)[0]:
+                n *= v.shape[a]
+            unbiased = var_t._value * (n / max(n - 1, 1))
+            running_var._value = (
+                momentum * running_var._value + (1 - momentum) * unbiased
+            ).astype(running_var._value.dtype)
+        return out
+
+    def g(v, m, s, w, b):
+        ax, bshape = stats_axes(v)
+        v32 = v.astype(jnp.float32)
+        inv = jnp.reciprocal(jnp.sqrt(s.astype(jnp.float32) + epsilon))
+        out = (v32 - m.astype(jnp.float32).reshape(bshape)) * inv.reshape(bshape)
+        if w is not None:
+            out = out * w.reshape(bshape).astype(jnp.float32)
+        if b is not None:
+            out = out + b.reshape(bshape).astype(jnp.float32)
+        return out.astype(v.dtype)
+
+    return apply_op(g, "batch_norm", x, running_mean, running_var, weight, bias)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(list(normalized_shape))
+
+    def f(v, w, b):
+        ax = tuple(range(v.ndim - n_axes, v.ndim))
+        v32 = v.astype(jnp.float32)
+        mean = jnp.mean(v32, axis=ax, keepdims=True)
+        var = jnp.var(v32, axis=ax, keepdims=True)
+        out = (v32 - mean) * jnp.reciprocal(jnp.sqrt(var + epsilon))
+        if w is not None:
+            out = out * w.astype(jnp.float32)
+        if b is not None:
+            out = out + b.astype(jnp.float32)
+        return out.astype(v.dtype)
+
+    return apply_op(f, "layer_norm", x, weight, bias)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (LLaMA-family). Not in the reference's functional API but required by its
+    model zoo consumers; TPU-native: single fused reduction."""
+
+    def f(v, w):
+        ms = jnp.mean(jnp.square(v.astype(jnp.float32)), axis=-1, keepdims=True)
+        out = (v.astype(jnp.float32) * jnp.reciprocal(jnp.sqrt(ms + epsilon))).astype(v.dtype)
+        if w is not None:
+            out = out * w
+        return out
+
+    return apply_op(f, "rms_norm", x, weight)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW",
+                  name=None):
+    chan_last = data_format.endswith("C") and len(data_format) > 2
+
+    def f(v, w, b):
+        if chan_last:
+            ax = tuple(range(1, v.ndim - 1))
+            bshape = (1,) * (v.ndim - 1) + (-1,)
+        else:
+            ax = tuple(range(2, v.ndim))
+            bshape = (1, -1) + (1,) * (v.ndim - 2)
+        mean = jnp.mean(v, axis=ax, keepdims=True)
+        var = jnp.var(v, axis=ax, keepdims=True)
+        out = (v - mean) * jnp.reciprocal(jnp.sqrt(var + eps))
+        if w is not None:
+            out = out * w.reshape(bshape)
+        if b is not None:
+            out = out + b.reshape(bshape)
+        return out
+
+    return apply_op(f, "instance_norm", x, weight, bias)
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None, data_format="NCHW",
+               name=None):
+    chan_last = data_format.endswith("C") and len(data_format) > 2
+
+    def f(v, w, b):
+        if chan_last:
+            v_ncx = jnp.moveaxis(v, -1, 1)
+        else:
+            v_ncx = v
+        n, c = v_ncx.shape[0], v_ncx.shape[1]
+        spatial = v_ncx.shape[2:]
+        g = v_ncx.reshape((n, num_groups, c // num_groups) + spatial)
+        ax = tuple(range(2, g.ndim))
+        mean = jnp.mean(g, axis=ax, keepdims=True)
+        var = jnp.var(g, axis=ax, keepdims=True)
+        out = ((g - mean) * jnp.reciprocal(jnp.sqrt(var + epsilon))).reshape(v_ncx.shape)
+        bshape = (1, -1) + (1,) * (v_ncx.ndim - 2)
+        if w is not None:
+            out = out * w.reshape(bshape)
+        if b is not None:
+            out = out + b.reshape(bshape)
+        if chan_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return apply_op(f, "group_norm", x, weight, bias)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW",
+                        name=None):
+    def f(v):
+        chan_last = data_format.endswith("C") and len(data_format) > 2
+        vv = jnp.moveaxis(v, -1, 1) if chan_last else v
+        sq = jnp.square(vv)
+        c = vv.shape[1]
+        half = size // 2
+        padded = jnp.pad(sq, [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (vv.ndim - 2))
+        acc = jnp.zeros_like(vv)
+        for i in range(size):
+            acc = acc + jnp.take(padded, jnp.arange(i, i + c), axis=1)
+        out = vv / jnp.power(k + alpha / size * acc, beta)
+        return jnp.moveaxis(out, 1, -1) if chan_last else out
+
+    return apply_op(f, "local_response_norm", x)

@@ -1,0 +1,164 @@
+"""Pooling functionals over lax.reduce_window.
+Reference: python/paddle/nn/functional/pooling.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops import apply_op
+
+__all__ = [
+    "avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d", "max_pool2d", "max_pool3d",
+    "adaptive_avg_pool1d", "adaptive_avg_pool2d", "adaptive_avg_pool3d",
+    "adaptive_max_pool1d", "adaptive_max_pool2d", "adaptive_max_pool3d",
+]
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _pads(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    return [tuple(p) for p in padding]
+
+
+def _pool(x, kernel, stride, padding, n, data_format, op, ceil_mode=False,
+          exclusive=True, count_include_pad=False):
+    k = _tuple(kernel, n)
+    s = _tuple(stride if stride is not None else kernel, n)
+    chan_last = data_format.endswith("C") and len(data_format) > 2
+    pads = _pads(padding, n)
+
+    def f(v):
+        if chan_last:
+            window = (1,) + k + (1,)
+            strides = (1,) + s + (1,)
+            p = "VALID" if isinstance(pads, str) and pads == "VALID" else pads
+            spatial_off = 1
+        else:
+            window = (1, 1) + k
+            strides = (1, 1) + s
+            spatial_off = 2
+        if isinstance(pads, str):
+            pad_cfg = pads
+        else:
+            pad_cfg = [(0, 0)] * spatial_off + list(pads) + ([(0, 0)] if chan_last else [])
+            if ceil_mode:
+                # extend hi pad so the last partial window is included
+                new_cfg = []
+                for i, (lo, hi) in enumerate(pad_cfg):
+                    d = i - spatial_off
+                    if 0 <= d < n:
+                        size = v.shape[i] + lo + hi
+                        rem = (size - k[d]) % s[d]
+                        extra = (s[d] - rem) % s[d] if rem else 0
+                        new_cfg.append((lo, hi + extra))
+                    else:
+                        new_cfg.append((lo, hi))
+                pad_cfg = new_cfg
+        if op == "max":
+            init = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) else jnp.iinfo(v.dtype).min
+            return jax.lax.reduce_window(v, init, jax.lax.max, window, strides, pad_cfg)
+        # avg
+        summed = jax.lax.reduce_window(v, 0.0, jax.lax.add, window, strides, pad_cfg)
+        if isinstance(pad_cfg, str) or (not exclusive) or count_include_pad:
+            denom = float(np.prod(k))
+            return summed / denom
+        ones = jnp.ones_like(v)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pad_cfg)
+        return summed / counts
+
+    return apply_op(f, f"{op}_pool{n}d", x)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False,
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "NCW", "avg", ceil_mode, exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+               divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, "avg", ceil_mode, exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+               divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, "avg", ceil_mode, exclusive)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "NCW", "max", ceil_mode)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
+               data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, "max", ceil_mode)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
+               data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, "max", ceil_mode)
+
+
+def _adaptive(x, output_size, n, op, data_format):
+    out_sizes = _tuple(output_size, n)
+    chan_last = data_format.endswith("C") and len(data_format) > 2
+
+    def f(v):
+        spatial = list(range(1, v.ndim - 1)) if chan_last else list(range(2, v.ndim))
+        vv = v
+        for d, o in zip(spatial, out_sizes):
+            if o is None:
+                continue
+            in_s = vv.shape[d]
+            # adaptive pooling: split into o regions with floor/ceil boundaries
+            starts = [int(np.floor(i * in_s / o)) for i in range(o)]
+            ends = [int(np.ceil((i + 1) * in_s / o)) for i in range(o)]
+            pieces = []
+            for st, en in zip(starts, ends):
+                seg = jax.lax.slice_in_dim(vv, st, en, axis=d)
+                if op == "max":
+                    pieces.append(jnp.max(seg, axis=d, keepdims=True))
+                else:
+                    pieces.append(jnp.mean(seg, axis=d, keepdims=True))
+            vv = jnp.concatenate(pieces, axis=d)
+        return vv
+
+    return apply_op(f, f"adaptive_{op}_pool{n}d", x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, "avg", "NCW")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, output_size, 2, "avg", data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, output_size, 3, "avg", data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 1, "max", "NCW")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 2, "max", "NCHW")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 3, "max", "NCDHW")

@@ -1,0 +1,90 @@
+"""Vision functionals: grid_sample, affine_grid. Reference:
+python/paddle/nn/functional/vision.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops import apply_op
+
+__all__ = ["grid_sample", "affine_grid"]
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    def f(th):
+        n, c, h, w = [int(s) for s in out_shape]
+        if align_corners:
+            ys = jnp.linspace(-1.0, 1.0, h)
+            xs = jnp.linspace(-1.0, 1.0, w)
+        else:
+            ys = (jnp.arange(h) + 0.5) * 2.0 / h - 1.0
+            xs = (jnp.arange(w) + 0.5) * 2.0 / w - 1.0
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)  # [H,W,3]
+        grid = jnp.einsum("hwk,nrk->nhwr", base.astype(th.dtype), th)
+        return grid  # [N,H,W,2]
+
+    return apply_op(f, "affine_grid", theta)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=True,
+                name=None):
+    def f(v, g):
+        n, c, h, w = v.shape
+        gx = g[..., 0]
+        gy = g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+
+        def sample(ix, iy):
+            if padding_mode == "border":
+                ix = jnp.clip(ix, 0, w - 1)
+                iy = jnp.clip(iy, 0, h - 1)
+                valid = jnp.ones_like(ix, bool)
+            elif padding_mode == "reflection":
+                def reflect(i, size):
+                    if align_corners:
+                        span = 2 * (size - 1)
+                        i = jnp.abs(i) % span if span > 0 else i * 0
+                        return jnp.where(i >= size, span - i, i)
+                    span = 2 * size
+                    i = jnp.mod(jnp.abs(i + 0.0), span)
+                    return jnp.where(i >= size, span - 1 - i, i)
+                ix = reflect(ix, w)
+                iy = reflect(iy, h)
+                ix = jnp.clip(ix, 0, w - 1)
+                iy = jnp.clip(iy, 0, h - 1)
+                valid = jnp.ones_like(ix, bool)
+            else:
+                valid = (ix >= 0) & (ix <= w - 1) & (iy >= 0) & (iy <= h - 1)
+                ix = jnp.clip(ix, 0, w - 1)
+                iy = jnp.clip(iy, 0, h - 1)
+            batch = jnp.arange(n).reshape(n, 1, 1)
+            vals = v[batch, :, iy.astype(jnp.int32), ix.astype(jnp.int32)]  # [N,Hg,Wg,C]
+            vals = jnp.where(valid[..., None], vals, 0.0)
+            return vals
+
+        if mode == "nearest":
+            out = sample(jnp.round(fx), jnp.round(fy))
+        else:
+            x0 = jnp.floor(fx)
+            y0 = jnp.floor(fy)
+            x1, y1 = x0 + 1, y0 + 1
+            wa = (x1 - fx) * (y1 - fy)
+            wb = (x1 - fx) * (fy - y0)
+            wc = (fx - x0) * (y1 - fy)
+            wd = (fx - x0) * (fy - y0)
+            out = (
+                sample(x0, y0) * wa[..., None]
+                + sample(x0, y1) * wb[..., None]
+                + sample(x1, y0) * wc[..., None]
+                + sample(x1, y1) * wd[..., None]
+            )
+        return jnp.moveaxis(out, -1, 1)  # [N,C,Hg,Wg]
+
+    return apply_op(f, "grid_sample", x, grid)

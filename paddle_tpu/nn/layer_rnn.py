@@ -1,0 +1,245 @@
+"""Recurrent layers via lax.scan (compiler-friendly sequential loop).
+Reference: python/paddle/nn/layer/rnn.py."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import apply_op
+from ..tensor import Tensor
+from . import initializer as I
+from .layer import Layer
+
+
+class _RNNCellBase(Layer):
+    pass
+
+
+class SimpleRNNCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([hidden_size, input_size],
+                                               weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size],
+                                               weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter([hidden_size], bias_ih_attr, is_bias=True,
+                                             default_initializer=u)
+        self.bias_hh = self.create_parameter([hidden_size], bias_hh_attr, is_bias=True,
+                                             default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            from ..ops.creation import zeros
+
+            states = zeros([inputs.shape[0], self.hidden_size], inputs.dtype)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def f(x, h, wi, wh, bi, bh):
+            out = act(x @ wi.T + bi + h @ wh.T + bh)
+            return out
+
+        out = apply_op(f, "rnn_cell", inputs, states, self.weight_ih, self.weight_hh,
+                       self.bias_ih, self.bias_hh)
+        return out, out
+
+
+class LSTMCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, proj_size=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size],
+                                               weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size],
+                                               weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter([4 * hidden_size], bias_ih_attr,
+                                             is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter([4 * hidden_size], bias_hh_attr,
+                                             is_bias=True, default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            from ..ops.creation import zeros
+
+            h = zeros([inputs.shape[0], self.hidden_size], inputs.dtype)
+            c = zeros([inputs.shape[0], self.hidden_size], inputs.dtype)
+            states = (h, c)
+        h, c = states
+
+        def f(x, hv, cv, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + hv @ wh.T + bh
+            i, fg, g, o = jnp.split(gates, 4, axis=-1)
+            i, fg, o = jax.nn.sigmoid(i), jax.nn.sigmoid(fg), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            new_c = fg * cv + i * g
+            new_h = o * jnp.tanh(new_c)
+            return new_h, new_c
+
+        new_h, new_c = apply_op(f, "lstm_cell", inputs, h, c, self.weight_ih,
+                                self.weight_hh, self.bias_ih, self.bias_hh)
+        return new_h, (new_h, new_c)
+
+
+class GRUCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size],
+                                               weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size],
+                                               weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter([3 * hidden_size], bias_ih_attr,
+                                             is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter([3 * hidden_size], bias_hh_attr,
+                                             is_bias=True, default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            from ..ops.creation import zeros
+
+            states = zeros([inputs.shape[0], self.hidden_size], inputs.dtype)
+
+        def f(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            ir, iz, ic = jnp.split(gi, 3, axis=-1)
+            hr, hz, hc = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            cand = jnp.tanh(ic + r * hc)
+            return cand + z * (h - cand)
+
+        out = apply_op(f, "gru_cell", inputs, states, self.weight_ih, self.weight_hh,
+                       self.bias_ih, self.bias_hh)
+        return out, out
+
+
+class RNN(Layer):
+    """Wraps a cell into a sequence loop. Reference rnn.py:RNN."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ..ops.manipulation import stack
+
+        # eager python loop (tape-friendly); jit path unrolls or scans via tracing
+        seq_axis = 0 if self.time_major else 1
+        steps = inputs.shape[seq_axis]
+        idxs = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        outputs = []
+        states = initial_states
+        for t in idxs:
+            xt = inputs[t] if self.time_major else inputs[:, t]
+            out, states = self.cell(xt, states)
+            outputs.append(out)
+        if self.is_reverse:
+            outputs = outputs[::-1]
+        out = stack(outputs, axis=seq_axis)
+        return out, states
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **cell_kwargs):
+        super().__init__()
+        self.mode = mode
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        num_dir = 2 if self.bidirect else 1
+        self.num_directions = num_dir
+        cell_cls = {"RNN_TANH": SimpleRNNCell, "RNN_RELU": SimpleRNNCell,
+                    "LSTM": LSTMCell, "GRU": GRUCell}[mode]
+        extra = {}
+        if mode == "RNN_TANH":
+            extra["activation"] = "tanh"
+        elif mode == "RNN_RELU":
+            extra["activation"] = "relu"
+        from .layer_common import LayerList
+
+        self.cells = LayerList()
+        for layer in range(num_layers):
+            for d in range(num_dir):
+                in_sz = input_size if layer == 0 else hidden_size * num_dir
+                self.cells.append(cell_cls(in_sz, hidden_size, **extra))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ..ops.manipulation import concat, stack
+
+        x = inputs
+        final_h, final_c = [], []
+        is_lstm = self.mode == "LSTM"
+        for layer in range(self.num_layers):
+            outs = []
+            hs = []
+            for d in range(self.num_directions):
+                cell = self.cells[layer * self.num_directions + d]
+                rnn = RNN(cell, is_reverse=(d == 1), time_major=self.time_major)
+                if initial_states is not None:
+                    if is_lstm:
+                        h0, c0 = initial_states
+                        idx = layer * self.num_directions + d
+                        st = (h0[idx], c0[idx])
+                    else:
+                        st = initial_states[layer * self.num_directions + d]
+                else:
+                    st = None
+                o, s = rnn(x, st)
+                outs.append(o)
+                hs.append(s)
+            x = outs[0] if len(outs) == 1 else concat(outs, axis=-1)
+            for s in hs:
+                if is_lstm:
+                    final_h.append(s[0])
+                    final_c.append(s[1])
+                else:
+                    final_h.append(s)
+            if self.dropout and layer < self.num_layers - 1 and self.training:
+                from . import functional as F
+
+                x = F.dropout(x, self.dropout, training=True)
+        h_stack = stack(final_h, axis=0)
+        if is_lstm:
+            c_stack = stack(final_c, axis=0)
+            return x, (h_stack, c_stack)
+        return x, h_stack
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kw):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction,
+                         time_major, dropout)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kw):
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kw):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout)

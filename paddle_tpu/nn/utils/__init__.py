@@ -1,0 +1,114 @@
+"""nn.utils: weight_norm, spectral_norm, parameter vector utils.
+Reference: python/paddle/nn/utils/."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...tensor import Tensor
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm", "parameters_to_vector",
+           "vector_to_parameters"]
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize weight = g * v/||v||. Implemented as a forward-pre-hook that
+    recomputes the weight from (g, v) parameters."""
+    from ..layer import Parameter
+
+    w = getattr(layer, name)
+    dim_ = dim if dim is not None else -1
+    axes = tuple(i for i in range(w.ndim) if i != (dim_ % w.ndim)) if dim is not None else None
+    norm = jnp.sqrt(jnp.sum(jnp.square(w._value), axis=axes, keepdims=True))
+    g = Parameter(jnp.squeeze(norm) if dim is None else norm.reshape(-1))
+    v = Parameter(w._value)
+    del layer._parameters[name]
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+
+    def hook(lyr, inputs):
+        vv = lyr._parameters[name + "_v"]
+        gg = lyr._parameters[name + "_g"]
+        nrm = jnp.sqrt(jnp.sum(jnp.square(vv._value), axis=axes, keepdims=True))
+        shape = [1] * vv.ndim
+        if dim is not None:
+            shape[dim_ % vv.ndim] = -1
+        new_w = vv._value / jnp.maximum(nrm, 1e-12) * gg._value.reshape(shape)
+        object.__setattr__(lyr, "_wn_cache", Tensor(new_w, stop_gradient=True))
+        # expose as plain attribute so forward uses it
+        lyr.__dict__[name] = _recompute_weight(vv, gg, axes, shape)
+        return None
+
+    layer.register_forward_pre_hook(hook)
+    layer._weight_norm_name = name
+    return layer
+
+
+def _recompute_weight(v, g, axes, shape):
+    from ...ops import apply_op
+
+    def f(vv, gg):
+        nrm = jnp.sqrt(jnp.sum(jnp.square(vv), axis=axes, keepdims=True))
+        return vv / jnp.maximum(nrm, 1e-12) * gg.reshape(shape)
+
+    return apply_op(f, "weight_norm", v, g)
+
+
+def remove_weight_norm(layer, name="weight"):
+    from ..layer import Parameter
+
+    if name + "_v" in layer._parameters:
+        v = layer._parameters[name + "_v"]
+        g = layer._parameters[name + "_g"]
+        w = layer.__dict__.get(name)
+        if w is None:
+            w = _recompute_weight(v, g, tuple(range(1, v.ndim)), [-1] + [1] * (v.ndim - 1))
+        del layer._parameters[name + "_v"]
+        del layer._parameters[name + "_g"]
+        layer.__dict__.pop(name, None)
+        layer.add_parameter(name, Parameter(w._value))
+        layer._forward_pre_hooks.clear()
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=None):
+    from ..layer import Parameter
+
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    state = {"u": None}
+
+    def hook(lyr, inputs):
+        wv = lyr._parameters[name]
+        mat = np.moveaxis(np.asarray(wv._value), dim, 0).reshape(wv.shape[dim], -1)
+        if state["u"] is None:
+            state["u"] = np.random.randn(mat.shape[0]).astype(np.float32)
+        u = state["u"]
+        for _ in range(n_power_iterations):
+            v = mat.T @ u
+            v = v / max(np.linalg.norm(v), eps)
+            u = mat @ v
+            u = u / max(np.linalg.norm(u), eps)
+        state["u"] = u
+        sigma = float(u @ mat @ v)
+        lyr.__dict__[name] = Tensor(wv._value / sigma, stop_gradient=wv.stop_gradient)
+        return None
+
+    layer.register_forward_pre_hook(hook)
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    from ...ops.manipulation import concat, reshape
+
+    return concat([reshape(p, [-1]) for p in parameters], axis=0)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    v = vec._value
+    for p in parameters:
+        n = p.size
+        p._value = v[offset:offset + n].reshape(p._value.shape)
+        offset += n

@@ -1,0 +1,123 @@
+"""Op layer: every paddle op as a pure jax function + tape recording.
+
+Reference parity: replaces the whole YAML→codegen→phi-kernel pipeline
+(paddle/phi/ops/yaml/ops.yaml, 470 ops; paddle/phi/kernels/, 2851 registrations in the
+reference) with ONE dispatch helper: `apply_op(fn, name, *tensors, **static_kwargs)`.
+`fn` is a jax function — XLA supplies every backend's kernel; the tape supplies autograd
+via `jax.vjp`; jit tracing works because Tensors wrap tracers transparently.
+"""
+from __future__ import annotations
+
+import builtins as _builtins
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd import tape
+from ..tensor import Tensor
+
+
+def _unwrap(a):
+    if isinstance(a, Tensor):
+        return a._value
+    return a
+
+
+def _is_diffable(a) -> bool:
+    return (
+        isinstance(a, Tensor)
+        and not a.stop_gradient
+        and (
+            jnp.issubdtype(a.dtype, jnp.floating)
+            or jnp.issubdtype(a.dtype, jnp.complexfloating)
+        )
+    )
+
+
+def apply_op(fn, name: str, *args, nout: int | None = None, **kwargs):
+    """Run `fn(*vals, **kwargs)`; record a tape node if autograd applies.
+
+    args may be Tensor / jax array / python scalar / None; kwargs are static
+    (never differentiated). Returns Tensor or tuple of Tensors (list outputs of fn are
+    returned as lists of Tensors, mirroring ops like `split`).
+    """
+    vals = [_unwrap(a) for a in args]
+    need_grad = tape.is_grad_enabled() and _builtins.any(_is_diffable(a) for a in args)
+
+    if not need_grad:
+        out = fn(*vals, **kwargs)
+        return _wrap_outputs(out, stop_gradient=True)
+
+    diff_idx = [i for i, a in enumerate(args) if _is_diffable(a)]
+
+    def closure(*diff_vals):
+        merged = list(vals)
+        for i, v in zip(diff_idx, diff_vals):
+            merged[i] = v
+        out = fn(*merged, **kwargs)
+        if isinstance(out, (tuple, list)):
+            return tuple(out), type(out) is list
+        return (out,), False
+
+    primals = [vals[i] for i in diff_idx]
+    out_tuple, vjp_fn, was_list = jax.vjp(closure, *primals, has_aux=True)
+
+    outputs = [Tensor(o, stop_gradient=False) for o in out_tuple]
+    tape.record(vjp_fn, [args[i] for i in diff_idx], outputs, name=name)
+    if len(outputs) == 1 and not was_list and nout is None:
+        return outputs[0]
+    return list(outputs) if was_list else tuple(outputs)
+
+
+def _wrap_outputs(out, stop_gradient=True):
+    if isinstance(out, tuple):
+        return tuple(Tensor(o, stop_gradient=stop_gradient) for o in out)
+    if isinstance(out, list):
+        return [Tensor(o, stop_gradient=stop_gradient) for o in out]
+    return Tensor(out, stop_gradient=stop_gradient)
+
+
+def unary_op(jfn, name):
+    def op(x, name=None):
+        return apply_op(jfn, name or op.__name__, x)
+
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = f"paddle.{name} — elementwise, lowered to jnp.{getattr(jfn, '__name__', name)}."
+    return op
+
+
+def binary_op(jfn, name):
+    def op(x, y, name=None):
+        return apply_op(jfn, op.__name__, x, y)
+
+    op.__name__ = name
+    op.__qualname__ = name
+    return op
+
+
+# Submodules (import order matters: creation/math monkey-patch Tensor methods).
+from . import creation  # noqa: E402
+from . import math  # noqa: E402
+from . import manipulation  # noqa: E402
+from . import logic  # noqa: E402
+from . import reduction  # noqa: E402
+from . import search  # noqa: E402
+from . import linalg  # noqa: E402
+from . import random  # noqa: E402
+from . import indexing  # noqa: E402
+from . import einsum as _einsum_mod  # noqa: E402
+
+from .creation import *  # noqa: F401,F403,E402
+from .math import *  # noqa: F401,F403,E402
+from .manipulation import *  # noqa: F401,F403,E402
+from .logic import *  # noqa: F401,F403,E402
+from .reduction import *  # noqa: F401,F403,E402
+from .search import *  # noqa: F401,F403,E402
+from .linalg import *  # noqa: F401,F403,E402
+from .random import *  # noqa: F401,F403,E402
+from .einsum import einsum  # noqa: F401,E402
+
+from . import patch_methods  # noqa: E402  (binds Tensor methods/operators)

@@ -1,0 +1,195 @@
+"""Creation ops. Reference: python/paddle/tensor/creation.py."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as _dt
+from ..tensor import Tensor, to_tensor  # noqa: F401 (re-exported)
+from . import apply_op
+
+__all__ = [
+    "to_tensor",
+    "zeros",
+    "ones",
+    "full",
+    "zeros_like",
+    "ones_like",
+    "full_like",
+    "empty",
+    "empty_like",
+    "arange",
+    "linspace",
+    "logspace",
+    "eye",
+    "diag",
+    "diagflat",
+    "tril",
+    "triu",
+    "meshgrid",
+    "assign",
+    "clone",
+    "complex",
+    "tril_indices",
+    "triu_indices",
+    "one_hot",
+]
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(s) for s in np.asarray(shape._value)]
+    if isinstance(shape, (int, np.integer)):
+        return [int(shape)]
+    return [int(s._value) if isinstance(s, Tensor) else int(s) for s in shape]
+
+
+def zeros(shape, dtype=None, name=None):
+    dtype = _dt.convert_dtype(dtype) or _dt.get_default_dtype()
+    return Tensor(jnp.zeros(_shape_list(shape), dtype))
+
+
+def ones(shape, dtype=None, name=None):
+    dtype = _dt.convert_dtype(dtype) or _dt.get_default_dtype()
+    return Tensor(jnp.ones(_shape_list(shape), dtype))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    dtype = _dt.convert_dtype(dtype)
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = np.dtype(bool)
+        elif isinstance(fill_value, int):
+            dtype = _dt.get_default_dtype()  # paddle full defaults to float
+        else:
+            dtype = _dt.get_default_dtype()
+    return Tensor(jnp.full(_shape_list(shape), fill_value, dtype))
+
+
+def zeros_like(x, dtype=None, name=None):
+    dtype = _dt.convert_dtype(dtype)
+    return Tensor(jnp.zeros_like(x._value if isinstance(x, Tensor) else x, dtype))
+
+
+def ones_like(x, dtype=None, name=None):
+    dtype = _dt.convert_dtype(dtype)
+    return Tensor(jnp.ones_like(x._value if isinstance(x, Tensor) else x, dtype))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    dtype = _dt.convert_dtype(dtype)
+    return Tensor(jnp.full_like(x._value if isinstance(x, Tensor) else x, fill_value, dtype))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    """paddle.arange — int64 default for int args, float for float args."""
+    if end is None:
+        start, end = 0, start
+    s = start.item() if isinstance(start, Tensor) else start
+    e = end.item() if isinstance(end, Tensor) else end
+    st = step.item() if isinstance(step, Tensor) else step
+    dtype = _dt.convert_dtype(dtype)
+    if dtype is None:
+        if any(isinstance(v, float) for v in (s, e, st)):
+            dtype = _dt.get_default_dtype()
+        else:
+            dtype = _dt.int64
+    return Tensor(jnp.arange(s, e, st, dtype=dtype))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    dtype = _dt.convert_dtype(dtype) or _dt.get_default_dtype()
+    s = start.item() if isinstance(start, Tensor) else start
+    e = stop.item() if isinstance(stop, Tensor) else stop
+    n = num.item() if isinstance(num, Tensor) else num
+    return Tensor(jnp.linspace(s, e, int(n), dtype=dtype))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    dtype = _dt.convert_dtype(dtype) or _dt.get_default_dtype()
+    return Tensor(jnp.logspace(float(start), float(stop), int(num), base=float(base), dtype=dtype))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    dtype = _dt.convert_dtype(dtype) or _dt.get_default_dtype()
+    return Tensor(jnp.eye(int(num_rows), None if num_columns is None else int(num_columns), dtype=dtype))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    if padding_value != 0 and getattr(x, "ndim", 1) == 1:
+        def g(v):
+            n = v.shape[0] + abs(offset)
+            out = jnp.full((n, n), padding_value, v.dtype)
+            idx = jnp.arange(v.shape[0])
+            r = idx if offset >= 0 else idx - offset
+            c = idx + offset if offset >= 0 else idx
+            return out.at[r, c].set(v)
+
+        return apply_op(g, "diag", x)
+    return apply_op(lambda v: jnp.diag(v, k=offset), "diag", x)
+
+
+def diagflat(x, offset=0, name=None):
+    return apply_op(lambda v: jnp.diagflat(v, k=offset), "diagflat", x)
+
+
+def tril(x, diagonal=0, name=None):
+    return apply_op(lambda v: jnp.tril(v, k=diagonal), "tril", x)
+
+
+def triu(x, diagonal=0, name=None):
+    return apply_op(lambda v: jnp.triu(v, k=diagonal), "triu", x)
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_dt.convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_dt.convert_dtype(dtype)))
+
+
+def meshgrid(*args, **kwargs):
+    tensors = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+    outs = jnp.meshgrid(*[t._value if isinstance(t, Tensor) else t for t in tensors], indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def assign(x, output=None):
+    src = x._value if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
+    if output is not None:
+        output._value = src
+        return output
+    return apply_op(lambda v: v + jnp.zeros((), v.dtype), "assign", x) if isinstance(x, Tensor) else Tensor(src)
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+def complex(real, imag, name=None):
+    return apply_op(lambda r, i: r + 1j * i, "complex", real, imag)
+
+
+def one_hot(x, num_classes, name=None):
+    from ..framework import dtype as _d
+
+    return apply_op(
+        lambda v: jnp.eye(num_classes, dtype=_d.get_default_dtype())[v.astype(jnp.int32)],
+        "one_hot",
+        x,
+    )

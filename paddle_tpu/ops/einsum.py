@@ -1,0 +1,13 @@
+"""einsum. Reference: python/paddle/tensor/einsum.py — here a direct jnp.einsum
+(XLA contracts on the MXU; no custom planner needed)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import apply_op
+
+__all__ = ["einsum"]
+
+
+def einsum(equation, *operands):
+    return apply_op(lambda *vs: jnp.einsum(equation, *vs), "einsum", *operands)

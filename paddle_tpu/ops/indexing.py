@@ -1,0 +1,76 @@
+"""__getitem__/__setitem__ semantics. Reference: python/paddle/base/variable_index.py."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor
+from . import apply_op
+
+
+def _convert_index(item):
+    """Normalize a paddle index into a jax-compatible index. Returns (index, dynamic)
+    where dynamic=True means data-dependent output shape (bool mask)."""
+    if isinstance(item, tuple):
+        converted = tuple(_convert_one(i) for i in item)
+        dynamic = any(d for _, d in converted)
+        return tuple(c for c, _ in converted), dynamic
+    c, d = _convert_one(item)
+    return c, d
+
+
+def _convert_one(i):
+    if isinstance(i, Tensor):
+        if jnp.issubdtype(i.dtype, jnp.bool_):
+            return np.asarray(i._value), True
+        return i._value.astype(jnp.int32), False
+    if isinstance(i, np.ndarray) and i.dtype == bool:
+        return i, True
+    if isinstance(i, (list, np.ndarray)):
+        arr = np.asarray(i)
+        if arr.dtype == bool:
+            return arr, True
+        return jnp.asarray(arr, jnp.int32), False
+    return i, False  # int / slice / None / Ellipsis
+
+
+def getitem(x, item):
+    idx, dynamic = _convert_index(item)
+    if dynamic:
+        # bool-mask select: data-dependent shape → host gather (outside jit only)
+        v = np.asarray(x._value)
+        return Tensor(jnp.asarray(v[_host_index(item)]))
+    return apply_op(lambda v: v[idx], "getitem", x)
+
+
+def _host_index(item):
+    if isinstance(item, tuple):
+        return tuple(_host_one(i) for i in item)
+    return _host_one(item)
+
+
+def _host_one(i):
+    if isinstance(i, Tensor):
+        return np.asarray(i._value)
+    if isinstance(i, (list, np.ndarray)):
+        return np.asarray(i)
+    return i
+
+
+def setitem(x, item, value):
+    """In-place semantics via functional .at[] update; rebinding the payload."""
+    val = value._value if isinstance(value, Tensor) else value
+    if isinstance(val, (int, float, bool)):
+        val = jnp.asarray(val, x._value.dtype)
+    elif not isinstance(val, jnp.ndarray):
+        val = jnp.asarray(np.asarray(val), x._value.dtype)
+    else:
+        val = val.astype(x._value.dtype)
+    idx, dynamic = _convert_index(item)
+    if dynamic:
+        v = np.asarray(x._value).copy()
+        v[_host_index(item)] = np.asarray(val)
+        x._value = jnp.asarray(v)
+        return x
+    x._value = x._value.at[idx].set(val)
+    return x

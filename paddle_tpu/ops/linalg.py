@@ -1,0 +1,293 @@
+"""Linear algebra ops. Reference: python/paddle/tensor/linalg.py.
+
+matmul is THE MXU op — everything here lowers to XLA dot_general so the TPU systolic array
+gets large fused contractions.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as _dt
+from ..tensor import Tensor
+from . import apply_op
+
+__all__ = [
+    "matmul", "mm", "bmm", "dot", "t", "norm", "dist", "cross", "cholesky",
+    "cholesky_solve", "bincount", "mv", "histogram", "histogramdd", "matrix_power", "qr",
+    "lu", "eig", "eigh", "eigvals", "eigvalsh", "svd", "pinv", "solve",
+    "triangular_solve", "lstsq", "slogdet", "det", "inverse", "matrix_rank", "cov",
+    "corrcoef", "cond", "vecdot", "multi_dot", "householder_product", "matrix_exp",
+]
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
+        return jnp.matmul(a, b)
+
+    return apply_op(f, "matmul", x, y)
+
+
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return apply_op(jnp.matmul, "bmm", x, y)
+
+
+def dot(x, y, name=None):
+    def f(a, b):
+        return jnp.sum(a * b, axis=-1)
+
+    return apply_op(f, "dot", x, y)
+
+
+def mv(x, vec, name=None):
+    return apply_op(jnp.matmul, "mv", x, vec)
+
+
+def t(input, name=None):
+    return apply_op(lambda v: v.T if v.ndim <= 2 else jnp.swapaxes(v, -1, -2), "t", input)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def f(v):
+        pp = p
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if pp is None:
+            pp = "fro" if (ax is None or isinstance(ax, tuple)) else 2
+        if ax is None and pp in ("fro", 2):
+            return jnp.sqrt(jnp.sum(jnp.square(jnp.abs(v))))
+        if pp == "fro":
+            return jnp.sqrt(jnp.sum(jnp.square(jnp.abs(v)), axis=ax, keepdims=keepdim))
+        if pp == "nuc":
+            s = jnp.linalg.svd(v, compute_uv=False)
+            return jnp.sum(s, axis=-1, keepdims=keepdim)
+        if pp == np.inf or pp == float("inf"):
+            return jnp.max(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if pp == -np.inf or pp == float("-inf"):
+            return jnp.min(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if pp == 0:
+            return jnp.sum((v != 0).astype(v.dtype), axis=ax, keepdims=keepdim)
+        return jnp.power(
+            jnp.sum(jnp.power(jnp.abs(v), pp), axis=ax, keepdims=keepdim), 1.0 / pp
+        )
+
+    return apply_op(f, "norm", x)
+
+
+def vecdot(x, y, axis=-1, name=None):
+    return apply_op(lambda a, b: jnp.sum(a * b, axis=axis), "vecdot", x, y)
+
+
+def dist(x, y, p=2, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        if p == 0:
+            return jnp.sum((d != 0).astype(a.dtype)).astype(a.dtype)
+        if p == float("inf"):
+            return jnp.max(d)
+        if p == float("-inf"):
+            return jnp.min(d)
+        return jnp.power(jnp.sum(jnp.power(d, p)), 1.0 / p)
+
+    return apply_op(f, "dist", x, y)
+
+
+def cross(x, y, axis=9, name=None):
+    def f(a, b):
+        ax = axis
+        if ax == 9:  # paddle default: first axis with dim 3
+            ax = next((i for i, s in enumerate(a.shape) if s == 3), -1)
+        return jnp.cross(a, b, axis=ax)
+
+    return apply_op(f, "cross", x, y)
+
+
+def cholesky(x, upper=False, name=None):
+    def f(v):
+        L = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(L, -1, -2).conj() if upper else L
+
+    return apply_op(f, "cholesky", x)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def f(b, chol):
+        return jax.scipy.linalg.cho_solve((chol, not upper), b)
+
+    return apply_op(f, "cholesky_solve", x, y)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    v = np.asarray(x._value)
+    w = np.asarray(weights._value) if isinstance(weights, Tensor) else weights
+    return Tensor(jnp.asarray(np.bincount(v, weights=w, minlength=minlength)))
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
+    v = np.asarray(input._value)
+    rng = None if (min == 0 and max == 0) else (min, max)
+    w = np.asarray(weight._value) if isinstance(weight, Tensor) else weight
+    h, _ = np.histogram(v, bins=bins, range=rng, weights=w, density=density)
+    return Tensor(jnp.asarray(h if density else h.astype(np.int64)))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    v = np.asarray(x._value)
+    w = np.asarray(weights._value) if isinstance(weights, Tensor) else weights
+    h, edges = np.histogramdd(v, bins=bins, range=ranges, density=density, weights=w)
+    return Tensor(jnp.asarray(h)), [Tensor(jnp.asarray(e)) for e in edges]
+
+
+def matrix_power(x, n, name=None):
+    return apply_op(lambda v: jnp.linalg.matrix_power(v, n), "matrix_power", x)
+
+
+def matrix_exp(x, name=None):
+    return apply_op(jax.scipy.linalg.expm, "matrix_exp", x)
+
+
+def qr(x, mode="reduced", name=None):
+    return apply_op(lambda v: tuple(jnp.linalg.qr(v, mode=mode)) if mode != "r"
+                    else (jnp.linalg.qr(v, mode="r"),), "qr", x) if mode == "r" else \
+        apply_op(lambda v: tuple(jnp.linalg.qr(v, mode=mode)), "qr", x)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def f(v):
+        lu_, piv = jax.scipy.linalg.lu_factor(v)
+        if get_infos:
+            return lu_, piv.astype(_dt.int32) + 1, jnp.zeros((), _dt.int32)
+        return lu_, piv.astype(_dt.int32) + 1
+
+    return apply_op(f, "lu", x)
+
+
+def eig(x, name=None):
+    v = np.asarray(x._value)
+    w, vec = np.linalg.eig(v)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(vec))
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply_op(lambda v: tuple(jnp.linalg.eigh(v, symmetrize_input=True)), "eigh", x)
+
+
+def eigvals(x, name=None):
+    v = np.asarray(x._value)
+    return Tensor(jnp.asarray(np.linalg.eigvals(v)))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply_op(lambda v: jnp.linalg.eigvalsh(v), "eigvalsh", x)
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply_op(
+        lambda v: tuple(jnp.linalg.svd(v, full_matrices=full_matrices)), "svd", x
+    )
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply_op(lambda v: jnp.linalg.pinv(v, rtol=rcond, hermitian=hermitian), "pinv", x)
+
+
+def solve(x, y, name=None):
+    def f(a, b):
+        if b.ndim == a.ndim - 1:
+            return jnp.linalg.solve(a, b[..., None])[..., 0]
+        return jnp.linalg.solve(a, b)
+
+    return apply_op(f, "solve", x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def f(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular
+        )
+
+    return apply_op(f, "triangular_solve", x, y)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def f(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank.astype(_dt.int64), sv
+
+    return apply_op(f, "lstsq", x, y)
+
+
+def slogdet(x, name=None):
+    def f(v):
+        sign, logdet = jnp.linalg.slogdet(v)
+        return jnp.stack([sign, logdet])
+
+    return apply_op(f, "slogdet", x)
+
+
+def det(x, name=None):
+    return apply_op(jnp.linalg.det, "det", x)
+
+
+def inverse(x, name=None):
+    return apply_op(jnp.linalg.inv, "inverse", x)
+
+
+def matrix_rank(x, tol=None, hermitian=False, atol=None, rtol=None, name=None):
+    def f(v):
+        return jnp.linalg.matrix_rank(v, rtol=tol if tol is not None else rtol).astype(_dt.int64)
+
+    return apply_op(f, "matrix_rank", x)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    def f(v, fw, aw):
+        return jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0, fweights=fw, aweights=aw)
+
+    return apply_op(f, "cov", x, fweights, aweights)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply_op(lambda v: jnp.corrcoef(v, rowvar=rowvar), "corrcoef", x)
+
+
+def cond(x, p=None, name=None):
+    def f(v):
+        return jnp.linalg.cond(v, p=p)
+
+    return apply_op(f, "cond", x)
+
+
+def multi_dot(x, name=None):
+    return apply_op(lambda *vs: jnp.linalg.multi_dot(vs), "multi_dot", *list(x))
+
+
+def householder_product(x, tau, name=None):
+    def f(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+
+        def apply_single(acc, i):
+            v = jnp.where(jnp.arange(m) < i, 0.0, a[..., :, i].at[..., i].set(1.0))
+            v = a[..., :, i]
+            v = jnp.where(jnp.arange(m) == i, 1.0, jnp.where(jnp.arange(m) < i, 0.0, v))
+            H = eye - t[..., i] * jnp.outer(v, v)
+            return acc @ H, None
+
+        Q = eye
+        for i in range(n):
+            v = a[..., :, i]
+            v = jnp.where(jnp.arange(m) == i, 1.0, jnp.where(jnp.arange(m) < i, 0.0, v))
+            H = eye - t[..., i] * jnp.outer(v, v)
+            Q = Q @ H
+        return Q[..., :, :n]
+
+    return apply_op(f, "householder_product", x, tau)

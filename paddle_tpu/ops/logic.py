@@ -1,0 +1,62 @@
+"""Comparison & logical ops. Reference: python/paddle/tensor/logic.py."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from . import apply_op
+
+__all__ = [
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than", "less_equal",
+    "logical_and", "logical_or", "logical_xor", "logical_not", "bitwise_and",
+    "bitwise_or", "bitwise_xor", "bitwise_not", "bitwise_left_shift",
+    "bitwise_right_shift", "is_empty", "is_tensor", "isreal", "iscomplex",
+]
+
+
+def _cmp(jfn, name):
+    def op(x, y, name=None):
+        return apply_op(lambda a, b: jfn(a, b), op.__name__, x, y)
+
+    op.__name__ = name
+    return op
+
+
+equal = _cmp(jnp.equal, "equal")
+not_equal = _cmp(jnp.not_equal, "not_equal")
+greater_than = _cmp(jnp.greater, "greater_than")
+greater_equal = _cmp(jnp.greater_equal, "greater_equal")
+less_than = _cmp(jnp.less, "less_than")
+less_equal = _cmp(jnp.less_equal, "less_equal")
+logical_and = _cmp(jnp.logical_and, "logical_and")
+logical_or = _cmp(jnp.logical_or, "logical_or")
+logical_xor = _cmp(jnp.logical_xor, "logical_xor")
+bitwise_and = _cmp(jnp.bitwise_and, "bitwise_and")
+bitwise_or = _cmp(jnp.bitwise_or, "bitwise_or")
+bitwise_xor = _cmp(jnp.bitwise_xor, "bitwise_xor")
+bitwise_left_shift = _cmp(jnp.left_shift, "bitwise_left_shift")
+bitwise_right_shift = _cmp(jnp.right_shift, "bitwise_right_shift")
+
+
+def logical_not(x, name=None):
+    return apply_op(jnp.logical_not, "logical_not", x)
+
+
+def bitwise_not(x, name=None):
+    return apply_op(jnp.bitwise_not, "bitwise_not", x)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def isreal(x, name=None):
+    return apply_op(jnp.isreal, "isreal", x)
+
+
+def iscomplex(x):
+    return jnp.issubdtype(x.dtype, jnp.complexfloating)

@@ -1,0 +1,564 @@
+"""Shape/layout manipulation ops. Reference: python/paddle/tensor/manipulation.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as _dt
+from ..tensor import Tensor
+from . import apply_op
+
+__all__ = [
+    "reshape", "reshape_", "flatten", "transpose", "moveaxis", "swapaxes", "squeeze",
+    "squeeze_", "unsqueeze", "unsqueeze_", "concat", "stack", "split", "chunk", "unbind",
+    "unstack", "tile", "expand", "expand_as", "broadcast_to", "broadcast_tensors", "flip",
+    "rot90", "roll", "repeat_interleave", "cast", "slice", "strided_slice", "crop",
+    "pad", "gather", "gather_nd", "scatter", "scatter_nd", "scatter_nd_add",
+    "index_select", "index_sample", "index_add", "index_put", "masked_select",
+    "masked_fill", "masked_scatter", "take_along_axis", "put_along_axis", "tensordot",
+    "as_complex", "as_real", "view", "view_as", "tolist", "atleast_1d", "atleast_2d",
+    "atleast_3d", "diagonal", "diag_embed", "flatten_", "shard_index", "unfold",
+    "split_sections",
+]
+
+
+def _axes(axis, ndim):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(a % ndim if isinstance(a, int) else int(a) % ndim for a in axis)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return axis % ndim if ndim else axis
+
+
+def reshape(x, shape, name=None):
+    if isinstance(shape, Tensor):
+        shape = [int(v) for v in np.asarray(shape._value)]
+    else:
+        shape = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape]
+    return apply_op(lambda v: jnp.reshape(v, shape), "reshape", x)
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._value = out._value
+    x._grad_node = out._grad_node
+    x._grad_index = out._grad_index
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return x.astype(shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def f(v):
+        nd = v.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = list(v.shape[:s]) + [-1] + list(v.shape[e + 1:])
+        return v.reshape(new_shape)
+
+    return apply_op(f, "flatten", x)
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    out = flatten(x, start_axis, stop_axis)
+    x._value, x._grad_node, x._grad_index = out._value, out._grad_node, out._grad_index
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def transpose(x, perm=None, name=None):
+    def f(v):
+        p = perm
+        if p is None:
+            p = list(range(v.ndim))[::-1]
+        return jnp.transpose(v, p)
+
+    return apply_op(f, "transpose", x)
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply_op(lambda v: jnp.moveaxis(v, source, destination), "moveaxis", x)
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply_op(lambda v: jnp.swapaxes(v, axis0, axis1), "swapaxes", x)
+
+
+def squeeze(x, axis=None, name=None):
+    def f(v):
+        ax = axis
+        if ax is None:
+            return jnp.squeeze(v)
+        if isinstance(ax, int):
+            ax = [ax]
+        ax = tuple(a % v.ndim for a in ax if v.shape[a % v.ndim] == 1)
+        return jnp.squeeze(v, axis=ax) if ax else v
+
+    return apply_op(f, "squeeze", x)
+
+
+def squeeze_(x, axis=None, name=None):
+    out = squeeze(x, axis)
+    x._value, x._grad_node, x._grad_index = out._value, out._grad_node, out._grad_index
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def unsqueeze(x, axis, name=None):
+    def f(v):
+        ax = axis
+        if isinstance(ax, Tensor):
+            ax = [int(a) for a in np.asarray(ax._value).reshape(-1)]
+        if isinstance(ax, int):
+            ax = [ax]
+        out = v
+        for a in sorted(a % (out.ndim + 1) for a in ax):
+            out = jnp.expand_dims(out, a)
+        return out
+
+    return apply_op(f, "unsqueeze", x)
+
+
+def unsqueeze_(x, axis, name=None):
+    out = unsqueeze(x, axis)
+    x._value, x._grad_node, x._grad_index = out._value, out._grad_node, out._grad_index
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def concat(x, axis=0, name=None):
+    tensors = list(x)
+    ax = axis.item() if isinstance(axis, Tensor) else axis
+    return apply_op(lambda *vs: jnp.concatenate(vs, axis=int(ax)), "concat", *tensors)
+
+
+def stack(x, axis=0, name=None):
+    return apply_op(lambda *vs: jnp.stack(vs, axis=axis), "stack", *list(x))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+
+    def f(v):
+        a = ax % v.ndim
+        if isinstance(num_or_sections, int):
+            return list(jnp.split(v, num_or_sections, axis=a))
+        secs = [
+            int(s.item()) if isinstance(s, Tensor) else int(s) for s in num_or_sections
+        ]
+        total = v.shape[a]
+        known = sum(s for s in secs if s >= 0)
+        secs = [s if s >= 0 else total - known for s in secs]
+        idx = np.cumsum(secs)[:-1]
+        return list(jnp.split(v, idx, axis=a))
+
+    return apply_op(f, "split", x)
+
+
+split_sections = split
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    n = x.shape[axis % x.ndim]
+
+    def f(v):
+        return [jnp.squeeze(s, axis % v.ndim) for s in jnp.split(v, n, axis % v.ndim)]
+
+    return apply_op(f, "unbind", x)
+
+
+unstack = unbind
+
+
+def tile(x, repeat_times, name=None):
+    if isinstance(repeat_times, Tensor):
+        repeat_times = [int(v) for v in np.asarray(repeat_times._value)]
+    repeat_times = [int(r.item()) if isinstance(r, Tensor) else int(r) for r in repeat_times]
+    return apply_op(lambda v: jnp.tile(v, repeat_times), "tile", x)
+
+
+def expand(x, shape, name=None):
+    if isinstance(shape, Tensor):
+        shape = [int(v) for v in np.asarray(shape._value)]
+    shape = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape]
+
+    def f(v):
+        tgt = list(shape)
+        # -1 means keep dim
+        vshape = (1,) * (len(tgt) - v.ndim) + v.shape
+        tgt = [vs if t == -1 else t for t, vs in zip(tgt, vshape)]
+        return jnp.broadcast_to(v.reshape(vshape), tgt)
+
+    return apply_op(f, "expand", x)
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    shapes = [tuple(t.shape) for t in inputs]
+    out_shape = np.broadcast_shapes(*shapes)
+    return [expand(t, list(out_shape)) for t in inputs]
+
+
+def flip(x, axis, name=None):
+    ax = axis if isinstance(axis, (list, tuple)) else [axis]
+    return apply_op(lambda v: jnp.flip(v, axis=tuple(ax)), "flip", x)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply_op(lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), "rot90", x)
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply_op(lambda v: jnp.roll(v, shifts, axis=axis), "roll", x)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    def f(v, r):
+        return jnp.repeat(v, r, axis=axis)
+
+    rep = repeats if isinstance(repeats, Tensor) else None
+    if rep is not None:
+        return apply_op(lambda v, r: jnp.repeat(v, r, axis=axis), "repeat_interleave", x, rep)
+    return apply_op(lambda v: jnp.repeat(v, repeats, axis=axis), "repeat_interleave", x)
+
+
+def cast(x, dtype):
+    d = _dt.convert_dtype(dtype)
+
+    def f(v):
+        return v.astype(d)
+
+    return apply_op(f, "cast", x)
+
+
+import builtins as _builtins
+
+builtins_slice = _builtins.slice
+
+
+def slice(input, axes, starts, ends):
+    def f(v):
+        idx = [builtins_slice(None)] * v.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            s = int(s.item()) if isinstance(s, Tensor) else int(s)
+            e = int(e.item()) if isinstance(e, Tensor) else int(e)
+            idx[ax] = builtins_slice(s, e)
+        return v[tuple(idx)]
+
+    return apply_op(f, "slice", input)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def f(v):
+        idx = [builtins_slice(None)] * v.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = builtins_slice(int(s), int(e), int(st))
+        return v[tuple(idx)]
+
+    return apply_op(f, "strided_slice", x)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shp = [int(s) for s in (shape or x.shape)]
+    offs = [int(o) for o in (offsets or [0] * len(shp))]
+
+    def f(v):
+        idx = tuple(builtins_slice(o, o + s) for o, s in zip(offs, shp))
+        return v[idx]
+
+    return apply_op(f, "crop", x)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    """paddle.nn.functional.pad semantics: `pad` is per-dim [lo, hi] pairs; for 4D/5D with
+    len(pad)==4/6 it pads spatial dims per data_format."""
+    if isinstance(pad, Tensor):
+        pad = [int(v) for v in np.asarray(pad._value)]
+    pad = [int(p) for p in pad]
+
+    def f(v):
+        nd = v.ndim
+        if len(pad) == 2 * nd:
+            widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            # spatial-only form, e.g. NCHW + [left,right,top,bottom]
+            widths = [(0, 0)] * nd
+            n_spatial = len(pad) // 2
+            if data_format.endswith("C"):  # NHWC/NDHWC: spatial dims 1..nd-2
+                spatial = list(range(1, 1 + n_spatial))
+            else:  # NCHW/NCDHW: spatial dims 2..
+                spatial = list(range(nd - n_spatial, nd))
+            # paddle orders pad pairs from last spatial dim outward? It orders as
+            # (dim_left...) per W,H,D i.e. reversed over spatial dims.
+            for i, d in enumerate(reversed(spatial)):
+                widths[d] = (pad[2 * i], pad[2 * i + 1])
+        jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+                 "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(v, widths, mode="constant", constant_values=value)
+        return jnp.pad(v, widths, mode=jmode)
+
+    return apply_op(f, "pad", x)
+
+
+def gather(x, index, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply_op(
+        lambda v, i: jnp.take(v, i.astype(jnp.int32).reshape(-1) if i.ndim else i.astype(jnp.int32), axis=ax),
+        "gather", x, index,
+    )
+
+
+def gather_nd(x, index, name=None):
+    def f(v, idx):
+        idx = idx.astype(jnp.int32)
+        k = idx.shape[-1]
+        out = v[tuple(jnp.moveaxis(idx, -1, 0))] if k == v.ndim else v[
+            tuple(jnp.moveaxis(idx, -1, 0))
+        ]
+        return out
+
+    return apply_op(f, "gather_nd", x, index)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(v, i, u):
+        i = i.astype(jnp.int32).reshape(-1)
+        if overwrite:
+            return v.at[i].set(u)
+        return v.at[i].set(0.0).at[i].add(u)
+
+    return apply_op(f, "scatter", x, index, updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    def f(i, u):
+        z = jnp.zeros(list(shape), u.dtype)
+        return z.at[tuple(jnp.moveaxis(i.astype(jnp.int32), -1, 0))].add(u)
+
+    return apply_op(f, "scatter_nd", index, updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def f(v, i, u):
+        return v.at[tuple(jnp.moveaxis(i.astype(jnp.int32), -1, 0))].add(u)
+
+    return apply_op(f, "scatter_nd_add", x, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply_op(
+        lambda v, i: jnp.take(v, i.astype(jnp.int32).reshape(-1), axis=axis),
+        "index_select", x, index,
+    )
+
+
+def index_sample(x, index):
+    def f(v, i):
+        rows = jnp.arange(v.shape[0])[:, None]
+        return v[rows, i.astype(jnp.int32)]
+
+    return apply_op(f, "index_sample", x, index)
+
+
+def index_add(x, index, axis, value, name=None):
+    def f(v, i, u):
+        idx = [builtins_slice(None)] * v.ndim
+        i = i.astype(jnp.int32)
+        moved = jnp.moveaxis(v, axis, 0)
+        um = jnp.moveaxis(u, axis, 0)
+        out = moved.at[i].add(um)
+        return jnp.moveaxis(out, 0, axis)
+
+    return apply_op(f, "index_add", x, index, value)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    def f(v, u, *idx):
+        idx = tuple(i.astype(jnp.int32) if jnp.issubdtype(i.dtype, jnp.integer) else i for i in idx)
+        if accumulate:
+            return v.at[idx].add(u)
+        return v.at[idx].set(u)
+
+    return apply_op(f, "index_put", x, value, *indices)
+
+
+def masked_select(x, mask, name=None):
+    # Data-dependent output shape: executes on host (documented dynamic-shape boundary,
+    # same as reference's dynamic kernels; under jit use masked_fill/where instead).
+    v = np.asarray(x._value)
+    m = np.asarray(mask._value if isinstance(mask, Tensor) else mask)
+    return Tensor(jnp.asarray(v[np.broadcast_to(m, v.shape)]))
+
+
+def masked_fill(x, mask, value, name=None):
+    def f(v, m, val):
+        val = jnp.asarray(val, v.dtype)
+        return jnp.where(m, val, v)
+
+    return apply_op(f, "masked_fill", x, mask, value if isinstance(value, Tensor) else None) \
+        if isinstance(value, Tensor) else apply_op(
+            lambda v, m: jnp.where(m, jnp.asarray(value, v.dtype), v), "masked_fill", x, mask)
+
+
+def masked_scatter(x, mask, value, name=None):
+    v = np.asarray(x._value).copy()
+    m = np.broadcast_to(np.asarray(mask._value), v.shape)
+    src = np.asarray(value._value).reshape(-1)
+    v[m] = src[: int(m.sum())]
+    return Tensor(jnp.asarray(v))
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return apply_op(
+        lambda v, i: jnp.take_along_axis(v, i.astype(jnp.int32), axis=axis),
+        "take_along_axis", arr, indices,
+    )
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True, broadcast=True, name=None):
+    def f(v, i, u):
+        i = i.astype(jnp.int32)
+        u = jnp.broadcast_to(jnp.asarray(u, v.dtype), i.shape)
+        if reduce == "assign":
+            return jnp.put_along_axis(v, i, u, axis=axis, inplace=False)
+        if reduce in ("add", "sum"):
+            dims = [jnp.arange(s) for s in i.shape]
+            mesh = jnp.meshgrid(*dims, indexing="ij")
+            full_idx = tuple(i if d == axis else mesh[d] for d in range(v.ndim))
+            return v.at[full_idx].add(u)
+        if reduce in ("mul", "multiply"):
+            dims = [jnp.arange(s) for s in i.shape]
+            mesh = jnp.meshgrid(*dims, indexing="ij")
+            full_idx = tuple(i if d == axis else mesh[d] for d in range(v.ndim))
+            return v.at[full_idx].multiply(u)
+        raise ValueError(f"unsupported reduce {reduce}")
+
+    val_t = values if isinstance(values, Tensor) else Tensor(jnp.asarray(values))
+    return apply_op(f, "put_along_axis", arr, indices, val_t)
+
+
+def tensordot(x, y, axes=2, name=None):
+    def norm_axes(a):
+        if isinstance(a, Tensor):
+            a = np.asarray(a._value).tolist()
+        if isinstance(a, (list, tuple)):
+            return tuple(tuple(t) if isinstance(t, (list, tuple)) else t for t in a)
+        return a
+
+    return apply_op(lambda a, b: jnp.tensordot(a, b, axes=norm_axes(axes)), "tensordot", x, y)
+
+
+def as_complex(x, name=None):
+    return apply_op(lambda v: jax.lax.complex(v[..., 0], v[..., 1]), "as_complex", x)
+
+
+def as_real(x, name=None):
+    return apply_op(lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1), "as_real", x)
+
+
+def tolist(x):
+    return x.tolist()
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply_op(jnp.atleast_1d, "atleast_1d", t) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply_op(jnp.atleast_2d, "atleast_2d", t) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply_op(jnp.atleast_3d, "atleast_3d", t) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op(
+        lambda v: jnp.diagonal(v, offset=offset, axis1=axis1, axis2=axis2), "diagonal", x
+    )
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):
+    def f(v):
+        n = v.shape[-1] + abs(offset)
+        base = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+        idx = jnp.arange(v.shape[-1])
+        r = idx if offset >= 0 else idx - offset
+        c = idx + offset if offset >= 0 else idx
+        out = base.at[..., r, c].set(v)
+        # move the two new dims into place
+        d1 = dim1 % out.ndim
+        d2 = dim2 % out.ndim
+        perm = [i for i in range(out.ndim) if i not in (out.ndim - 2, out.ndim - 1)]
+        order = sorted([(d1, out.ndim - 2), (d2, out.ndim - 1)])
+        for pos, src in order:
+            perm.insert(pos, src)
+        return jnp.transpose(out, perm)
+
+    return apply_op(f, "diag_embed", input)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def f(v):
+        shard_size = (index_num + nshards - 1) // nshards
+        lo = shard_id * shard_size
+        hi = lo + shard_size
+        in_shard = (v >= lo) & (v < hi)
+        return jnp.where(in_shard, v - lo, ignore_value)
+
+    return apply_op(f, "shard_index", input)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (paddle.nn.functional.unfold). x: [N,C,H,W] → [N, C*kh*kw, L]."""
+    kh, kw = (kernel_sizes, kernel_sizes) if isinstance(kernel_sizes, int) else kernel_sizes
+    sh, sw = (strides, strides) if isinstance(strides, int) else strides
+    dh, dw = (dilations, dilations) if isinstance(dilations, int) else dilations
+    if isinstance(paddings, int):
+        pt = pb = pl = pr = paddings
+    elif len(paddings) == 2:
+        pt = pb = paddings[0]
+        pl = pr = paddings[1]
+    else:
+        pt, pl, pb, pr = paddings
+
+    def f(v):
+        n, c, h, w = v.shape
+        vp = jnp.pad(v, ((0, 0), (0, 0), (pt, pb), (pl, pr)))
+        hp, wp = vp.shape[2], vp.shape[3]
+        oh = (hp - (dh * (kh - 1) + 1)) // sh + 1
+        ow = (wp - (dw * (kw - 1) + 1)) // sw + 1
+        patches = jax.lax.conv_general_dilated_patches(
+            vp, (kh, kw), (sh, sw), "VALID", rhs_dilation=(dh, dw),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )  # [N, C*kh*kw, oh, ow]
+        return patches.reshape(n, c * kh * kw, oh * ow)
+
+    return apply_op(f, "unfold", x)

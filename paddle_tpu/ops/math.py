@@ -1,0 +1,341 @@
+"""Elementwise & scalar math ops. Reference: python/paddle/tensor/math.py / ops.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as _dt
+from ..tensor import Tensor
+from . import apply_op, binary_op, unary_op
+
+__all__ = [
+    # unary
+    "abs", "acos", "acosh", "asin", "asinh", "atan", "atanh", "ceil", "cos", "cosh",
+    "deg2rad", "rad2deg", "digamma", "erf", "erfinv", "exp", "expm1", "floor", "frac",
+    "i0", "lgamma", "log", "log10", "log1p", "log2", "logit", "neg", "reciprocal",
+    "round", "rsqrt", "sign", "sgn", "sin", "sinh", "sqrt", "square", "tan", "tanh",
+    "trunc", "angle", "conj", "real", "imag", "isfinite", "isinf", "isnan", "nan_to_num",
+    # binary
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod", "remainder", "pow",
+    "maximum", "minimum", "fmax", "fmin", "atan2", "logaddexp", "heaviside", "hypot",
+    "nextafter", "copysign", "gcd", "lcm", "ldexp", "inner", "outer", "kron", "lerp",
+    "trapezoid", "cumulative_trapezoid", "diff",
+    # scalar-ish / misc
+    "scale", "clip", "stanh", "multiplex", "addmm",
+    "cumsum", "cumprod", "cummax", "cummin", "logcumsumexp",
+    "isclose", "allclose", "equal_all",
+    "increment", "count_nonzero", "broadcast_shape",
+]
+
+# ------------------------------------------------------------------ unary family
+abs = unary_op(jnp.abs, "abs")
+acos = unary_op(jnp.arccos, "acos")
+acosh = unary_op(jnp.arccosh, "acosh")
+asin = unary_op(jnp.arcsin, "asin")
+asinh = unary_op(jnp.arcsinh, "asinh")
+atan = unary_op(jnp.arctan, "atan")
+atanh = unary_op(jnp.arctanh, "atanh")
+ceil = unary_op(jnp.ceil, "ceil")
+cos = unary_op(jnp.cos, "cos")
+cosh = unary_op(jnp.cosh, "cosh")
+deg2rad = unary_op(jnp.deg2rad, "deg2rad")
+rad2deg = unary_op(jnp.rad2deg, "rad2deg")
+digamma = unary_op(jax.scipy.special.digamma, "digamma")
+erf = unary_op(jax.scipy.special.erf, "erf")
+erfinv = unary_op(jax.scipy.special.erfinv, "erfinv")
+exp = unary_op(jnp.exp, "exp")
+expm1 = unary_op(jnp.expm1, "expm1")
+floor = unary_op(jnp.floor, "floor")
+i0 = unary_op(jnp.i0, "i0")
+lgamma = unary_op(jax.scipy.special.gammaln, "lgamma")
+log = unary_op(jnp.log, "log")
+log10 = unary_op(jnp.log10, "log10")
+log1p = unary_op(jnp.log1p, "log1p")
+log2 = unary_op(jnp.log2, "log2")
+neg = unary_op(jnp.negative, "neg")
+reciprocal = unary_op(jnp.reciprocal, "reciprocal")
+round = unary_op(jnp.round, "round")
+rsqrt = unary_op(jax.lax.rsqrt, "rsqrt")
+sign = unary_op(jnp.sign, "sign")
+sgn = unary_op(jnp.sign, "sgn")
+sin = unary_op(jnp.sin, "sin")
+sinh = unary_op(jnp.sinh, "sinh")
+sqrt = unary_op(jnp.sqrt, "sqrt")
+square = unary_op(jnp.square, "square")
+tan = unary_op(jnp.tan, "tan")
+tanh = unary_op(jnp.tanh, "tanh")
+trunc = unary_op(jnp.trunc, "trunc")
+angle = unary_op(jnp.angle, "angle")
+conj = unary_op(jnp.conj, "conj")
+real = unary_op(jnp.real, "real")
+imag = unary_op(jnp.imag, "imag")
+isfinite = unary_op(jnp.isfinite, "isfinite")
+isinf = unary_op(jnp.isinf, "isinf")
+isnan = unary_op(jnp.isnan, "isnan")
+
+
+def frac(x, name=None):
+    return apply_op(lambda v: v - jnp.trunc(v), "frac", x)
+
+
+def logit(x, eps=None, name=None):
+    def f(v):
+        u = v if eps is None else jnp.clip(v, eps, 1.0 - eps)
+        return jnp.log(u / (1.0 - u))
+
+    return apply_op(f, "logit", x)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply_op(
+        lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf, neginf=neginf), "nan_to_num", x
+    )
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply_op(lambda v: scale_b * jnp.tanh(scale_a * v), "stanh", x)
+
+
+# ------------------------------------------------------------------ binary family
+def _promote_binop(jfn, name):
+    """Binary op with paddle-ish type promotion: Tensor op python-scalar keeps tensor
+    dtype for ints, promotes int tensor + float scalar to default float."""
+
+    def op(x, y, name=None):
+        def f(a, b):
+            a_t = isinstance(x, Tensor)
+            b_t = isinstance(y, Tensor)
+            if a_t and not b_t and isinstance(y, (int, float, bool)) and not isinstance(y, bool):
+                if isinstance(y, float) and jnp.issubdtype(jnp.asarray(a).dtype, jnp.integer):
+                    a = a.astype(_dt.get_default_dtype())
+                else:
+                    b = jnp.asarray(y, dtype=jnp.asarray(a).dtype) if not isinstance(y, float) else b
+            if b_t and not a_t and isinstance(x, (int, float, bool)) and not isinstance(x, bool):
+                if isinstance(x, float) and jnp.issubdtype(jnp.asarray(b).dtype, jnp.integer):
+                    b = b.astype(_dt.get_default_dtype())
+                elif not isinstance(x, float):
+                    a = jnp.asarray(x, dtype=jnp.asarray(b).dtype)
+            return jfn(a, b)
+
+        return apply_op(f, op.__name__, x, y)
+
+    op.__name__ = name
+    op.__qualname__ = name
+    return op
+
+
+add = _promote_binop(jnp.add, "add")
+subtract = _promote_binop(jnp.subtract, "subtract")
+multiply = _promote_binop(jnp.multiply, "multiply")
+mod = _promote_binop(jnp.mod, "mod")
+remainder = mod
+maximum = _promote_binop(jnp.maximum, "maximum")
+minimum = _promote_binop(jnp.minimum, "minimum")
+fmax = _promote_binop(jnp.fmax, "fmax")
+fmin = _promote_binop(jnp.fmin, "fmin")
+atan2 = _promote_binop(jnp.arctan2, "atan2")
+logaddexp = _promote_binop(jnp.logaddexp, "logaddexp")
+heaviside = _promote_binop(jnp.heaviside, "heaviside")
+hypot = _promote_binop(jnp.hypot, "hypot")
+nextafter = _promote_binop(jnp.nextafter, "nextafter")
+copysign = _promote_binop(jnp.copysign, "copysign")
+gcd = binary_op(jnp.gcd, "gcd")
+lcm = binary_op(jnp.lcm, "lcm")
+ldexp = binary_op(jnp.ldexp, "ldexp")
+
+
+def divide(x, y, name=None):
+    """paddle.divide — true division; int/int promotes to float (paddle semantics)."""
+
+    def f(a, b):
+        a, b = jnp.asarray(a), jnp.asarray(b)
+        if jnp.issubdtype(a.dtype, jnp.integer) and jnp.issubdtype(b.dtype, jnp.integer):
+            a = a.astype(_dt.get_default_dtype())
+            b = b.astype(_dt.get_default_dtype())
+        return jnp.true_divide(a, b)
+
+    return apply_op(f, "divide", x, y)
+
+
+def floor_divide(x, y, name=None):
+    return apply_op(lambda a, b: jnp.floor_divide(a, b), "floor_divide", x, y)
+
+
+def pow(x, y, name=None):
+    def f(a, b):
+        return jnp.power(a, b)
+
+    return apply_op(f, "pow", x, y)
+
+
+def inner(x, y, name=None):
+    return apply_op(jnp.inner, "inner", x, y)
+
+
+def outer(x, y, name=None):
+    return apply_op(lambda a, b: jnp.outer(a, b), "outer", x, y)
+
+
+def kron(x, y, name=None):
+    return apply_op(jnp.kron, "kron", x, y)
+
+
+def lerp(x, y, weight, name=None):
+    return apply_op(lambda a, b, w: a + w * (b - a), "lerp", x, y, weight)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    def f(yv, xv):
+        return jnp.trapezoid(yv, x=xv, dx=1.0 if dx is None else dx, axis=axis)
+
+    return apply_op(f, "trapezoid", y, x)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    def f(yv, xv):
+        d = dx if dx is None or xv is None else None
+        import jax.scipy.integrate as _int  # noqa
+
+        # manual: 0.5*(y[i]+y[i+1])*dx cumulative
+        yv = jnp.moveaxis(yv, axis, -1)
+        if xv is not None:
+            xv = jnp.moveaxis(xv, axis, -1) if xv.ndim > 1 else xv
+            dxs = jnp.diff(xv, axis=-1)
+        else:
+            dxs = d if d is not None else 1.0
+        avg = 0.5 * (yv[..., 1:] + yv[..., :-1]) * dxs
+        return jnp.moveaxis(jnp.cumsum(avg, axis=-1), -1, axis)
+
+    return apply_op(f, "cumulative_trapezoid", y, x)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    return apply_op(
+        lambda v, p, a: jnp.diff(v, n=n, axis=axis, prepend=p, append=a),
+        "diff", x, prepend, append,
+    )
+
+
+# ------------------------------------------------------------------ misc
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s, b = scale, bias
+
+    def f(v, sv):
+        sv2 = sv if sv is not None else s
+        out = v * sv2 + b if bias_after_scale else (v + b) * sv2
+        return out.astype(v.dtype) if not jnp.issubdtype(v.dtype, jnp.floating) else out
+
+    st = s if isinstance(s, Tensor) else None
+    out = apply_op(f, "scale", x, st)
+    return out
+
+
+def clip(x, min=None, max=None, name=None):
+    def f(v, lo, hi):
+        return jnp.clip(v, lo, hi)
+
+    return apply_op(f, "clip", x, min, max)
+
+
+def multiplex(inputs, index, name=None):
+    def f(idx, *ins):
+        stacked = jnp.stack(ins, axis=0)
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[idx.reshape(-1).astype(jnp.int32), rows]
+
+    return apply_op(f, "multiplex", index, *inputs)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply_op(
+        lambda i, a, b: beta * i + alpha * (a @ b), "addmm", input, x, y
+    )
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    d = _dt.convert_dtype(dtype)
+    return apply_op(lambda v: jnp.cumsum(v, axis=axis, dtype=d), "cumsum", x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    d = _dt.convert_dtype(dtype)
+    return apply_op(lambda v: jnp.cumprod(v, axis=dim, dtype=d), "cumprod", x)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def f(v):
+        ax = axis
+        if ax is None:
+            v = v.reshape(-1)
+            ax = 0
+        vals = jax.lax.associative_scan(jnp.maximum, v, axis=ax)
+        # indices: position of current running max
+        n = v.shape[ax]
+        ar = jnp.arange(n).reshape([-1 if i == ax % v.ndim else 1 for i in range(v.ndim)])
+        eq = v == vals
+        run_idx = jax.lax.associative_scan(jnp.maximum, jnp.where(eq, ar, -1), axis=ax)
+        return vals, run_idx.astype(_dt.convert_dtype(dtype))
+
+    return apply_op(f, "cummax", x)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    def f(v):
+        ax = axis
+        if ax is None:
+            v = v.reshape(-1)
+            ax = 0
+        vals = jax.lax.associative_scan(jnp.minimum, v, axis=ax)
+        n = v.shape[ax]
+        ar = jnp.arange(n).reshape([-1 if i == ax % v.ndim else 1 for i in range(v.ndim)])
+        eq = v == vals
+        run_idx = jax.lax.associative_scan(jnp.maximum, jnp.where(eq, ar, -1), axis=ax)
+        return vals, run_idx.astype(_dt.convert_dtype(dtype))
+
+    return apply_op(f, "cummin", x)
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    # numerically-stable: global max subtraction before the scan
+    def g(v):
+        ax = 0 if axis is None else axis
+        vv = v.reshape(-1) if axis is None else v
+        m = jnp.max(vv, axis=ax, keepdims=True)
+        return m + jnp.log(jnp.cumsum(jnp.exp(vv - m), axis=ax))
+
+    return apply_op(g, "logcumsumexp", x)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op(
+        lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        "isclose", x, y,
+    )
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op(
+        lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        "allclose", x, y,
+    )
+
+
+def equal_all(x, y, name=None):
+    return apply_op(lambda a, b: jnp.array_equal(a, b), "equal_all", x, y)
+
+
+def increment(x, value=1.0, name=None):
+    x._value = x._value + jnp.asarray(value, x._value.dtype)
+    return x
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply_op(
+        lambda v: jnp.count_nonzero(v, axis=axis, keepdims=keepdim).astype(_dt.int64),
+        "count_nonzero", x,
+    )
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))

@@ -1,0 +1,128 @@
+"""Monkey-patch the op surface onto Tensor as methods + operators.
+
+Reference parity: the reference binds ~400 methods onto the eager Tensor in
+python/paddle/tensor/__init__.py (`monkey_patch_tensor`); we do the same so user code
+written method-style (`x.sum(1).sqrt()`) works.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework import dtype as _dt
+from ..tensor import Tensor
+from . import (
+    creation,
+    einsum as _einsum,
+    indexing,
+    linalg,
+    logic,
+    manipulation,
+    math,
+    random as _random,
+    reduction,
+    search,
+)
+from . import apply_op
+
+_MODULES = [math, manipulation, logic, reduction, search, linalg, creation]
+
+_SKIP = {"to_tensor", "meshgrid", "broadcast_shape", "assign"}
+
+for mod in _MODULES:
+    for name in getattr(mod, "__all__", []):
+        if name in _SKIP or hasattr(Tensor, name):
+            continue
+        fn = getattr(mod, name)
+        setattr(Tensor, name, fn)
+
+# explicit extras / renames
+Tensor.astype = lambda self, dtype: manipulation.cast(self, dtype)
+Tensor.cast = Tensor.astype
+Tensor.type_as = lambda self, other: manipulation.cast(self, other.dtype)
+Tensor.reshape = manipulation.reshape
+Tensor.reshape_ = manipulation.reshape_
+Tensor.numel = lambda self: creation.to_tensor(self.size)
+Tensor.element_size = lambda self: self.dtype.itemsize
+Tensor.rank = lambda self: creation.to_tensor(self.ndim)
+Tensor.mm = linalg.mm
+Tensor.matmul = linalg.matmul
+Tensor.dot = linalg.dot
+Tensor.norm = linalg.norm
+Tensor.unique = search.unique
+Tensor.einsum = lambda self, eq, *others: _einsum.einsum(eq, self, *others)
+Tensor.fill_ = lambda self, v: self._replace_(jnp.full_like(self._value, v))
+Tensor.zero_ = lambda self: self._replace_(jnp.zeros_like(self._value))
+Tensor.uniform_ = _random.uniform_
+Tensor.normal_ = _random.normal_
+Tensor.exponential_ = _random.exponential_
+
+
+# in-place arithmetic variants (paddle `add_`, `subtract_`, `scale_`, `clip_`)
+def _make_inplace(fname):
+    base = getattr(math, fname)
+
+    def inplace(self, *args, **kwargs):
+        out = base(self, *args, **kwargs)
+        self._value = out._value
+        return self
+
+    inplace.__name__ = fname + "_"
+    return inplace
+
+
+for _f in ["add", "subtract", "multiply", "divide", "scale", "clip", "floor", "ceil",
+           "round", "sqrt", "rsqrt", "exp", "abs", "tanh", "remainder", "mod", "pow"]:
+    setattr(Tensor, _f + "_", _make_inplace(_f))
+
+# ------------------------------------------------------------------ operators
+Tensor.__getitem__ = indexing.getitem
+Tensor.__setitem__ = indexing.setitem
+
+Tensor.__add__ = lambda s, o: math.add(s, o)
+Tensor.__radd__ = lambda s, o: math.add(o if isinstance(o, Tensor) else creation.to_tensor(o, dtype=_rhs_dtype(s, o)), s)
+Tensor.__sub__ = lambda s, o: math.subtract(s, o)
+Tensor.__rsub__ = lambda s, o: math.subtract(creation.to_tensor(o, dtype=_rhs_dtype(s, o)), s)
+Tensor.__mul__ = lambda s, o: math.multiply(s, o)
+Tensor.__rmul__ = lambda s, o: math.multiply(creation.to_tensor(o, dtype=_rhs_dtype(s, o)), s)
+Tensor.__truediv__ = lambda s, o: math.divide(s, o)
+Tensor.__rtruediv__ = lambda s, o: math.divide(creation.to_tensor(o, dtype=_rhs_dtype(s, o)), s)
+Tensor.__floordiv__ = lambda s, o: math.floor_divide(s, o)
+Tensor.__rfloordiv__ = lambda s, o: math.floor_divide(creation.to_tensor(o), s)
+Tensor.__mod__ = lambda s, o: math.mod(s, o)
+Tensor.__rmod__ = lambda s, o: math.mod(creation.to_tensor(o, dtype=_rhs_dtype(s, o)), s)
+Tensor.__pow__ = lambda s, o: math.pow(s, o)
+Tensor.__rpow__ = lambda s, o: math.pow(creation.to_tensor(o, dtype=_rhs_dtype(s, o)), s)
+Tensor.__neg__ = lambda s: math.neg(s)
+Tensor.__abs__ = lambda s: math.abs(s)
+Tensor.__matmul__ = lambda s, o: linalg.matmul(s, o)
+Tensor.__rmatmul__ = lambda s, o: linalg.matmul(creation.to_tensor(o), s)
+
+Tensor.__eq__ = lambda s, o: logic.equal(s, o)
+Tensor.__ne__ = lambda s, o: logic.not_equal(s, o)
+Tensor.__lt__ = lambda s, o: logic.less_than(s, o)
+Tensor.__le__ = lambda s, o: logic.less_equal(s, o)
+Tensor.__gt__ = lambda s, o: logic.greater_than(s, o)
+Tensor.__ge__ = lambda s, o: logic.greater_equal(s, o)
+
+Tensor.__and__ = lambda s, o: logic.bitwise_and(s, o) if not _is_bool(s) else logic.logical_and(s, o)
+Tensor.__or__ = lambda s, o: logic.bitwise_or(s, o) if not _is_bool(s) else logic.logical_or(s, o)
+Tensor.__xor__ = lambda s, o: logic.bitwise_xor(s, o) if not _is_bool(s) else logic.logical_xor(s, o)
+Tensor.__invert__ = lambda s: logic.bitwise_not(s) if not _is_bool(s) else logic.logical_not(s)
+Tensor.__lshift__ = lambda s, o: logic.bitwise_left_shift(s, o)
+Tensor.__rshift__ = lambda s, o: logic.bitwise_right_shift(s, o)
+
+# T property
+Tensor.T = property(lambda s: manipulation.transpose(s))
+Tensor.mT = property(lambda s: manipulation.swapaxes(s, -1, -2))
+
+
+def _is_bool(t):
+    return jnp.issubdtype(t.dtype, jnp.bool_)
+
+
+def _rhs_dtype(t, o):
+    if isinstance(o, float) and jnp.issubdtype(t.dtype, jnp.integer):
+        return _dt.get_default_dtype()
+    if isinstance(o, (int, float)) and not isinstance(o, bool):
+        return t.dtype if not (isinstance(o, float) and jnp.issubdtype(t.dtype, jnp.integer)) else _dt.get_default_dtype()
+    return None

@@ -1,0 +1,148 @@
+"""Random ops over the stateless key chain. Reference: python/paddle/tensor/random.py.
+
+Each call pulls a fresh fold-in key from framework.random (reproducible after
+paddle.seed); everything is jax.random so it shards/jits cleanly.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as _dt
+from ..framework import random as _rng
+from ..tensor import Tensor
+
+__all__ = [
+    "uniform", "uniform_", "normal", "normal_", "standard_normal", "randn", "rand",
+    "randint", "randint_like", "randperm", "multinomial", "bernoulli", "poisson",
+    "exponential_", "binomial", "standard_gamma", "log_normal", "cauchy_", "geometric_",
+]
+
+
+def _key():
+    return _rng.next_key()
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    dtype = _dt.convert_dtype(dtype) or _dt.get_default_dtype()
+    shape = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape]
+    k = jax.random.key(seed) if seed else _key()
+    return Tensor(jax.random.uniform(k, shape, dtype=dtype, minval=min, maxval=max))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    x._value = jax.random.uniform(
+        _key(), x._value.shape, dtype=x._value.dtype, minval=min, maxval=max
+    )
+    return x
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else jnp.asarray(mean, _dt.get_default_dtype())
+        s = std._value if isinstance(std, Tensor) else jnp.asarray(std, _dt.get_default_dtype())
+        out_shape = np.broadcast_shapes(m.shape, s.shape)
+        z = jax.random.normal(_key(), out_shape, dtype=jnp.result_type(m, s))
+        return Tensor(m + s * z)
+    shape = [int(v) for v in (shape or [1])]
+    z = jax.random.normal(_key(), shape, dtype=_dt.get_default_dtype())
+    return Tensor(mean + std * z)
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    z = jax.random.normal(_key(), x._value.shape, dtype=x._value.dtype)
+    x._value = mean + std * z
+    return x
+
+
+def standard_normal(shape, dtype=None, name=None):
+    dtype = _dt.convert_dtype(dtype) or _dt.get_default_dtype()
+    shape = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape]
+    return Tensor(jax.random.normal(_key(), shape, dtype=dtype))
+
+
+def randn(shape, dtype=None, name=None):
+    return standard_normal(shape, dtype)
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, min=0.0, max=1.0)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    dtype = _dt.convert_dtype(dtype)
+    shape = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape]
+    return Tensor(jax.random.randint(_key(), shape, low, high, dtype=dtype))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    dtype = _dt.convert_dtype(dtype) or x.dtype
+    return Tensor(jax.random.randint(_key(), x._value.shape, low, high).astype(dtype))
+
+
+def randperm(n, dtype="int64", name=None):
+    dtype = _dt.convert_dtype(dtype)
+    return Tensor(jax.random.permutation(_key(), n).astype(dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    def sample(v):
+        if replacement:
+            logits = jnp.log(jnp.maximum(v, 1e-30))
+            return jax.random.categorical(_key(), logits, axis=-1, shape=v.shape[:-1] + (num_samples,))
+        # without replacement: Gumbel top-k trick
+        g = jax.random.gumbel(_key(), v.shape, dtype=jnp.float32)
+        scores = jnp.log(jnp.maximum(v.astype(jnp.float32), 1e-30)) + g
+        _, idx = jax.lax.top_k(scores, num_samples)
+        return idx
+
+    out = sample(x._value)
+    return Tensor(out.astype(_dt.int64))
+
+
+def bernoulli(x, name=None):
+    u = jax.random.uniform(_key(), x._value.shape, dtype=jnp.float32)
+    return Tensor((u < x._value.astype(jnp.float32)).astype(x._value.dtype))
+
+
+def poisson(x, name=None):
+    return Tensor(jax.random.poisson(_key(), x._value).astype(x._value.dtype))
+
+
+def binomial(count, prob, name=None):
+    c = count._value if isinstance(count, Tensor) else jnp.asarray(count)
+    p = prob._value if isinstance(prob, Tensor) else jnp.asarray(prob)
+    out = jax.random.binomial(_key(), c.astype(jnp.float32), p.astype(jnp.float32))
+    return Tensor(out.astype(_dt.int64))
+
+
+def standard_gamma(x, name=None):
+    return Tensor(jax.random.gamma(_key(), x._value))
+
+
+def exponential_(x, lam=1.0, name=None):
+    x._value = jax.random.exponential(_key(), x._value.shape, dtype=x._value.dtype) / lam
+    return x
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    shape = [int(v) for v in (shape or [1])]
+    z = jax.random.normal(_key(), shape, dtype=_dt.get_default_dtype())
+    return Tensor(jnp.exp(mean + std * z))
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    x._value = loc + scale * jax.random.cauchy(_key(), x._value.shape, dtype=x._value.dtype)
+    return x
+
+
+def geometric_(x, probs, name=None):
+    p = probs._value if isinstance(probs, Tensor) else jnp.asarray(probs, x._value.dtype)
+    u = jax.random.uniform(_key(), x._value.shape, dtype=jnp.float32)
+    x._value = (jnp.ceil(jnp.log1p(-u) / jnp.log1p(-p))).astype(x._value.dtype)
+    return x

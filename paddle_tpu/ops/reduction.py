@@ -1,0 +1,199 @@
+"""Reduction ops. Reference: python/paddle/tensor/math.py (sum/mean/...) & stat.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as _dt
+from ..tensor import Tensor
+from . import apply_op
+
+__all__ = [
+    "sum", "mean", "max", "min", "prod", "amax", "amin", "all", "any", "std", "var",
+    "median", "nanmedian", "nansum", "nanmean", "quantile", "nanquantile", "logsumexp",
+    "mode", "kthvalue",
+]
+
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        a = np.asarray(axis._value)
+        return tuple(int(v) for v in a.reshape(-1)) if a.ndim else int(a)
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    d = _dt.convert_dtype(dtype)
+
+    def f(v):
+        out = jnp.sum(v, axis=ax, keepdims=keepdim, dtype=d)
+        if d is None and jnp.issubdtype(v.dtype, jnp.bool_):
+            out = out.astype(_dt.int64)
+        return out
+
+    return apply_op(f, "sum", x)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply_op(lambda v: jnp.mean(v, axis=ax, keepdims=keepdim), "mean", x)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    ax = _norm_axis(axis)
+    d = _dt.convert_dtype(dtype)
+    return apply_op(lambda v: jnp.prod(v, axis=ax, keepdims=keepdim, dtype=d), "prod", x)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply_op(lambda v: jnp.max(v, axis=ax, keepdims=keepdim), "max", x)
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply_op(lambda v: jnp.min(v, axis=ax, keepdims=keepdim), "min", x)
+
+
+amax = max
+amin = min
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply_op(lambda v: jnp.all(v, axis=ax, keepdims=keepdim), "all", x)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply_op(lambda v: jnp.any(v, axis=ax, keepdims=keepdim), "any", x)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply_op(
+        lambda v: jnp.std(v, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim), "std", x
+    )
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply_op(
+        lambda v: jnp.var(v, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim), "var", x
+    )
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = _norm_axis(axis)
+
+    def f(v):
+        if mode == "avg":
+            return jnp.median(v, axis=ax, keepdims=keepdim)
+        # 'min' mode: lower of the two middle values + its index (paddle returns both)
+        vv = v.reshape(-1) if ax is None else v
+        a = 0 if ax is None else ax
+        n = vv.shape[a]
+        k = (n - 1) // 2
+        sorted_v = jnp.sort(vv, axis=a)
+        sorted_i = jnp.argsort(vv, axis=a)
+        vals = jnp.take(sorted_v, jnp.asarray([k]), axis=a)
+        idxs = jnp.take(sorted_i, jnp.asarray([k]), axis=a)
+        if not keepdim:
+            vals = jnp.squeeze(vals, axis=a)
+            idxs = jnp.squeeze(idxs, axis=a)
+        return vals, idxs.astype(_dt.int64)
+
+    return apply_op(f, "median", x)
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = _norm_axis(axis)
+    return apply_op(lambda v: jnp.nanmedian(v, axis=ax, keepdims=keepdim), "nanmedian", x)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    d = _dt.convert_dtype(dtype)
+    return apply_op(lambda v: jnp.nansum(v, axis=ax, keepdims=keepdim, dtype=d), "nansum", x)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply_op(lambda v: jnp.nanmean(v, axis=ax, keepdims=keepdim), "nanmean", x)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    ax = _norm_axis(axis)
+    qv = q._value if isinstance(q, Tensor) else jnp.asarray(q)
+    return apply_op(
+        lambda v: jnp.quantile(
+            v.astype(jnp.float64) if v.dtype == jnp.float64 else v.astype(jnp.float32),
+            qv, axis=ax, keepdims=keepdim, method=interpolation
+        ),
+        "quantile", x,
+    )
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    ax = _norm_axis(axis)
+    qv = q._value if isinstance(q, Tensor) else jnp.asarray(q)
+    return apply_op(
+        lambda v: jnp.nanquantile(v.astype(jnp.float32), qv, axis=ax, keepdims=keepdim,
+                                  method=interpolation),
+        "nanquantile", x,
+    )
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply_op(
+        lambda v: jax.scipy.special.logsumexp(v, axis=ax, keepdims=keepdim), "logsumexp", x
+    )
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    def f(v):
+        vals = jnp.sort(v, axis=axis)
+        idxs = jnp.argsort(v, axis=axis)
+        # mode = most frequent; for floats paddle picks largest on tie. Simple approach:
+        # compare each sorted element with neighbors to get run lengths via cumsum trick.
+        moved = jnp.moveaxis(vals, axis, -1)
+        n = moved.shape[-1]
+        same = jnp.concatenate(
+            [jnp.zeros(moved.shape[:-1] + (1,), bool), moved[..., 1:] == moved[..., :-1]],
+            axis=-1,
+        )
+        run_id = jnp.cumsum(~same, axis=-1)
+        counts = jax.nn.one_hot(run_id, n + 1, dtype=jnp.int32).sum(-2)
+        run_len = jnp.take_along_axis(counts, run_id, axis=-1)
+        best = jnp.argmax(run_len, axis=-1)  # last max wins → largest value on tie
+        best = (n - 1) - jnp.argmax(jnp.flip(run_len, -1), axis=-1)
+        mode_vals = jnp.take_along_axis(moved, best[..., None], axis=-1)[..., 0]
+        midx = jnp.moveaxis(idxs, axis, -1)
+        mode_idx = jnp.take_along_axis(midx, best[..., None], axis=-1)[..., 0]
+        if keepdim:
+            mode_vals = jnp.expand_dims(mode_vals, axis)
+            mode_idx = jnp.expand_dims(mode_idx, axis)
+        return mode_vals, mode_idx.astype(_dt.int64)
+
+    return apply_op(f, "mode", x)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(v):
+        vals = jnp.sort(v, axis=axis)
+        idxs = jnp.argsort(v, axis=axis)
+        sel = jnp.take(vals, jnp.asarray([k - 1]), axis=axis)
+        seli = jnp.take(idxs, jnp.asarray([k - 1]), axis=axis)
+        if not keepdim:
+            sel = jnp.squeeze(sel, axis)
+            seli = jnp.squeeze(seli, axis)
+        return sel, seli.astype(_dt.int64)
+
+    return apply_op(f, "kthvalue", x)

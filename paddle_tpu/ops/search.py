@@ -1,0 +1,159 @@
+"""Search/sort ops. Reference: python/paddle/tensor/search.py."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as _dt
+from ..tensor import Tensor
+from . import apply_op
+
+__all__ = [
+    "argmax", "argmin", "argsort", "sort", "topk", "where", "nonzero", "searchsorted",
+    "bucketize", "index_of_max", "unique", "unique_consecutive",
+]
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = _dt.convert_dtype(dtype)
+    return apply_op(
+        lambda v: jnp.argmax(v, axis=axis, keepdims=keepdim if axis is not None else False).astype(d),
+        "argmax", x,
+    )
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = _dt.convert_dtype(dtype)
+    return apply_op(
+        lambda v: jnp.argmin(v, axis=axis, keepdims=keepdim if axis is not None else False).astype(d),
+        "argmin", x,
+    )
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(v):
+        idx = jnp.argsort(v, axis=axis, stable=True, descending=descending)
+        return idx.astype(_dt.int64)
+
+    return apply_op(f, "argsort", x)
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(v):
+        out = jnp.sort(v, axis=axis, stable=True, descending=descending)
+        return out
+
+    return apply_op(f, "sort", x)
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    kk = int(k.item()) if isinstance(k, Tensor) else int(k)
+
+    def f(v):
+        ax = v.ndim - 1 if axis is None else axis % v.ndim
+        vv = jnp.moveaxis(v, ax, -1)
+        if largest:
+            vals, idx = _topk_last(vv, kk)
+        else:
+            nvals, idx = _topk_last(-vv, kk)
+            vals = -nvals
+        return (
+            jnp.moveaxis(vals, -1, ax),
+            jnp.moveaxis(idx.astype(_dt.int64), -1, ax),
+        )
+
+    return apply_op(f, "topk", x)
+
+
+def _topk_last(v, k):
+    import jax
+
+    return jax.lax.top_k(v, k)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+
+    def f(c, a, b):
+        if a.dtype != b.dtype:
+            rd = jnp.result_type(a, b)
+            a, b = a.astype(rd), b.astype(rd)
+        return jnp.where(c, a, b)
+
+    xt = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    yt = y if isinstance(y, Tensor) else Tensor(jnp.asarray(y))
+    return apply_op(f, "where", condition, xt, yt)
+
+
+def nonzero(x, as_tuple=False):
+    # data-dependent shape → host computation (documented dynamic boundary)
+    v = np.asarray(x._value)
+    nz = np.nonzero(v)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i.reshape(-1, 1), dtype=_dt.int64)) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1), dtype=_dt.int64))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    d = _dt.int32 if out_int32 else _dt.int64
+
+    def f(s, v):
+        if s.ndim == 1:
+            return jnp.searchsorted(s, v, side=side).astype(d)
+        import jax
+
+        return jax.vmap(lambda ss, vv: jnp.searchsorted(ss, vv, side=side))(
+            s.reshape(-1, s.shape[-1]), v.reshape(-1, v.shape[-1])
+        ).reshape(v.shape).astype(d)
+
+    return apply_op(f, "searchsorted", sorted_sequence, values)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def index_of_max(x, axis=None):
+    return argmax(x, axis)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None,
+           dtype="int64", name=None):
+    v = np.asarray(x._value)
+    res = np.unique(v, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    d = _dt.convert_dtype(dtype)
+    if not (return_index or return_inverse or return_counts):
+        return Tensor(jnp.asarray(res))
+    out = [Tensor(jnp.asarray(res[0]))]
+    for extra in res[1:]:
+        out.append(Tensor(jnp.asarray(extra.astype(d))))
+    return tuple(out)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    v = np.asarray(x._value)
+    if axis is None:
+        v = v.reshape(-1)
+        ax = 0
+    else:
+        ax = axis
+    moved = np.moveaxis(v, ax, 0)
+    keep = np.ones(moved.shape[0], bool)
+    if moved.shape[0] > 1:
+        eq = (moved[1:] == moved[:-1]).reshape(moved.shape[0] - 1, -1).all(axis=1)
+        keep[1:] = ~eq
+    uniq = np.moveaxis(moved[keep], 0, ax)
+    outs = [Tensor(jnp.asarray(uniq))]
+    d = _dt.convert_dtype(dtype)
+    if return_inverse:
+        grp = np.cumsum(keep) - 1
+        outs.append(Tensor(jnp.asarray(grp.astype(d))))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, moved.shape[0]))
+        outs.append(Tensor(jnp.asarray(counts.astype(d))))
+    return outs[0] if len(outs) == 1 else tuple(outs)

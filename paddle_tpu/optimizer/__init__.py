@@ -1,0 +1,462 @@
+"""Optimizers. Reference: python/paddle/optimizer/ (17 files).
+
+Each optimizer keeps raw jax-array state ("accumulators") keyed by parameter identity and
+exposes paddle's API: step()/minimize()/clear_grad(). The update math is pure jnp — under
+the functional training path the same `_update` rules run inside one jitted step.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd import no_grad
+from ..nn.clip import ClipGradBase
+from ..tensor import Tensor
+from . import lr as lr_mod
+from .lr import LRScheduler
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad",
+           "Adadelta", "RMSProp", "Lamb", "LBFGS", "lr"]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        if parameters is None:
+            raise ValueError("parameters must be provided (dygraph mode)")
+        # support param groups: list of dicts with 'params' key
+        self._param_groups = []
+        params = list(parameters)
+        if params and isinstance(params[0], dict):
+            for g in params:
+                grp = dict(g)
+                grp["params"] = list(g["params"])
+                self._param_groups.append(grp)
+        else:
+            self._param_groups.append({"params": params})
+        self._learning_rate = learning_rate
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._accumulators: dict[str, dict[int, Any]] = {}
+        self._master_weights: dict[int, Any] = {}
+        self._step_count = 0
+
+    # ------------------------------------------------------------------ lr
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = value
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    def _parameters_list(self):
+        for group in self._param_groups:
+            for p in group["params"]:
+                yield group, p
+
+    # ------------------------------------------------------------------ accumulators
+    def _acc(self, name, p, init=None):
+        store = self._accumulators.setdefault(name, {})
+        if id(p) not in store:
+            store[id(p)] = init if init is not None else jnp.zeros_like(p._value)
+        return store[id(p)]
+
+    def _set_acc(self, name, p, value):
+        self._accumulators[name][id(p)] = value
+
+    # ------------------------------------------------------------------ main api
+    @no_grad()
+    def step(self):
+        params_grads = []
+        for group, p in self._parameters_list():
+            if p.stop_gradient or p._grad is None:
+                continue
+            params_grads.append((p, p.grad))
+        if self._grad_clip is not None and isinstance(self._grad_clip, ClipGradBase):
+            params_grads = self._grad_clip(params_grads)
+        self._step_count += 1
+        for p, g in params_grads:
+            if g is None:
+                continue
+            lr = self.get_lr() * p.optimize_attr.get("learning_rate", 1.0) if hasattr(
+                p, "optimize_attr") else self.get_lr()
+            gval = g._value.astype(jnp.float32) if self._multi_precision else g._value
+            pval = p._value
+            if self._multi_precision and jnp.issubdtype(pval.dtype, jnp.floating) and \
+                    pval.dtype != jnp.float32:
+                if id(p) not in self._master_weights:
+                    self._master_weights[id(p)] = pval.astype(jnp.float32)
+                master = self._master_weights[id(p)]
+                new_master = self._update(p, master, gval, lr)
+                self._master_weights[id(p)] = new_master
+                p._value = new_master.astype(pval.dtype)
+            else:
+                p._value = self._update(
+                    p, pval, gval.astype(pval.dtype), lr
+                ).astype(pval.dtype)
+
+    def _update(self, p, pval, g, lr):
+        raise NotImplementedError
+
+    def _apply_decay(self, p, pval, g):
+        """L2 regularization folded into the gradient (paddle's default weight_decay
+        semantics for non-AdamW optimizers). Per-param regularizer overrides the
+        optimizer-level coefficient (reference behavior)."""
+        wd = getattr(p, "regularizer", None)
+        if wd is None:
+            wd = self._weight_decay
+        if wd is None:
+            return g
+        if hasattr(wd, "_coeff"):
+            wd = wd._coeff
+        if isinstance(wd, (int, float)) and wd != 0.0:
+            return g + jnp.asarray(wd, g.dtype) * pval
+        return g
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for _, p in self._parameters_list()]
+
+    def clear_grad(self, set_to_zero=False):
+        for _, p in self._parameters_list():
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    # ------------------------------------------------------------------ state dict
+    def state_dict(self):
+        out = {}
+        names = self._param_names()
+        for acc_name, store in self._accumulators.items():
+            for pid, val in store.items():
+                out[f"{names.get(pid, pid)}_{acc_name}"] = Tensor(val)
+        if isinstance(self._learning_rate, LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        out["@step"] = self._step_count
+        return out
+
+    def set_state_dict(self, state):
+        names = {v: k for k, v in self._param_names().items()}
+        self._step_count = int(state.get("@step", 0))
+        if "LR_Scheduler" in state and isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state["LR_Scheduler"])
+        # Accumulators are created lazily by step(); restoring before the first step
+        # must still land, so match against the class-declared accumulator names too.
+        acc_names = set(self._accumulators) | set(getattr(self, "_acc_names", ()))
+        for key, val in state.items():
+            if key in ("@step", "LR_Scheduler"):
+                continue
+            for acc_name in acc_names:
+                suffix = "_" + acc_name
+                if key.endswith(suffix):
+                    pname = key[: -len(suffix)]
+                    if pname in names:
+                        self._accumulators.setdefault(acc_name, {})[names[pname]] = (
+                            val._value if isinstance(val, Tensor) else jnp.asarray(val)
+                        )
+                    break
+
+    def _param_names(self):
+        return {id(p): p.name for _, p in self._parameters_list()}
+
+
+class SGD(Optimizer):
+    _acc_names = ()
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name,
+                         multi_precision)
+
+    def _update(self, p, pval, g, lr):
+        g = self._apply_decay(p, pval, g)
+        return pval - lr * g
+
+
+class Momentum(Optimizer):
+    _acc_names = ("velocity",)
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name,
+                         multi_precision)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _update(self, p, pval, g, lr):
+        g = self._apply_decay(p, pval, g)
+        v = self._acc("velocity", p)
+        v = self._momentum * v + g
+        self._set_acc("velocity", p, v)
+        if self._nesterov:
+            return pval - lr * (g + self._momentum * v)
+        return pval - lr * v
+
+
+class Adam(Optimizer):
+    _acc_names = ("moment1", "moment2", "moment2_max")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, use_multi_tensor=False, amsgrad=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name,
+                         multi_precision)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._amsgrad = amsgrad
+
+    def _beta_pows(self, p):
+        t = self._step_count
+        b1 = self._beta1 if not isinstance(self._beta1, Tensor) else float(self._beta1.item())
+        b2 = self._beta2 if not isinstance(self._beta2, Tensor) else float(self._beta2.item())
+        return b1, b2, b1**t, b2**t
+
+    def _update(self, p, pval, g, lr):
+        g = self._apply_decay(p, pval, g)
+        b1, b2, b1p, b2p = self._beta_pows(p)
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * jnp.square(g)
+        self._set_acc("moment1", p, m)
+        self._set_acc("moment2", p, v)
+        if self._amsgrad:
+            vmax = self._acc("moment2_max", p)
+            vmax = jnp.maximum(vmax, v)
+            self._set_acc("moment2_max", p, vmax)
+            vv = vmax
+        else:
+            vv = v
+        mhat = m / (1 - b1p)
+        vhat = vv / (1 - b2p)
+        return pval - lr * mhat / (jnp.sqrt(vhat) + self._eps)
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False, amsgrad=False,
+                 name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters, None,
+                         grad_clip, lazy_mode, multi_precision, amsgrad=amsgrad, name=name)
+        self._wd_coeff = weight_decay if not hasattr(weight_decay, "_coeff") else weight_decay._coeff
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _update(self, p, pval, g, lr):
+        if self._lr_ratio is not None:
+            lr = lr * self._lr_ratio(p)
+        decay = self._wd_coeff
+        if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(p.name):
+            decay = 0.0
+        b1, b2, b1p, b2p = self._beta_pows(p)
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * jnp.square(g)
+        self._set_acc("moment1", p, m)
+        self._set_acc("moment2", p, v)
+        if self._amsgrad:
+            vmax = jnp.maximum(self._acc("moment2_max", p), v)
+            self._set_acc("moment2_max", p, vmax)
+            vv = vmax
+        else:
+            vv = v
+        mhat = m / (1 - b1p)
+        vhat = vv / (1 - b2p)
+        pnew = pval * (1.0 - lr * decay)
+        return pnew - lr * mhat / (jnp.sqrt(vhat) + self._eps)
+
+
+class Adamax(Optimizer):
+    _acc_names = ("moment", "inf_norm")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _update(self, p, pval, g, lr):
+        g = self._apply_decay(p, pval, g)
+        t = self._step_count
+        m = self._acc("moment", p)
+        u = self._acc("inf_norm", p)
+        m = self._beta1 * m + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * u, jnp.abs(g))
+        self._set_acc("moment", p, m)
+        self._set_acc("inf_norm", p, u)
+        return pval - lr / (1 - self._beta1**t) * m / (u + self._eps)
+
+
+class Adagrad(Optimizer):
+    _acc_names = ("moment",)
+
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None, weight_decay=None,
+                 grad_clip=None, initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _update(self, p, pval, g, lr):
+        g = self._apply_decay(p, pval, g)
+        acc = self._acc("moment", p, jnp.full_like(p._value, self._init_acc))
+        acc = acc + jnp.square(g)
+        self._set_acc("moment", p, acc)
+        return pval - lr * g / (jnp.sqrt(acc) + self._eps)
+
+
+class Adadelta(Optimizer):
+    _acc_names = ("avg_squared_grad", "avg_squared_update")
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._eps, self._rho = epsilon, rho
+
+    def _update(self, p, pval, g, lr):
+        g = self._apply_decay(p, pval, g)
+        avg_sq = self._acc("avg_squared_grad", p)
+        avg_upd = self._acc("avg_squared_update", p)
+        avg_sq = self._rho * avg_sq + (1 - self._rho) * jnp.square(g)
+        delta = jnp.sqrt(avg_upd + self._eps) / jnp.sqrt(avg_sq + self._eps) * g
+        avg_upd = self._rho * avg_upd + (1 - self._rho) * jnp.square(delta)
+        self._set_acc("avg_squared_grad", p, avg_sq)
+        self._set_acc("avg_squared_update", p, avg_upd)
+        return pval - lr * delta
+
+
+class RMSProp(Optimizer):
+    _acc_names = ("mean_square", "mean_grad", "momentum")
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._eps, self._momentum, self._centered = rho, epsilon, momentum, centered
+
+    def _update(self, p, pval, g, lr):
+        g = self._apply_decay(p, pval, g)
+        ms = self._acc("mean_square", p)
+        ms = self._rho * ms + (1 - self._rho) * jnp.square(g)
+        self._set_acc("mean_square", p, ms)
+        if self._centered:
+            mg = self._acc("mean_grad", p)
+            mg = self._rho * mg + (1 - self._rho) * g
+            self._set_acc("mean_grad", p, mg)
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._eps)
+        else:
+            denom = jnp.sqrt(ms + self._eps)
+        mom = self._acc("momentum", p)
+        mom = self._momentum * mom + lr * g / denom
+        self._set_acc("momentum", p, mom)
+        return pval - mom
+
+
+class Lamb(Optimizer):
+    _acc_names = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         multi_precision)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _update(self, p, pval, g, lr):
+        t = self._step_count
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        m = self._beta1 * m + (1 - self._beta1) * g
+        v = self._beta2 * v + (1 - self._beta2) * jnp.square(g)
+        self._set_acc("moment1", p, m)
+        self._set_acc("moment2", p, v)
+        mhat = m / (1 - self._beta1**t)
+        vhat = v / (1 - self._beta2**t)
+        r = mhat / (jnp.sqrt(vhat) + self._eps)
+        wd = self._lamb_wd
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            wd = 0.0
+        update = r + wd * pval
+        w_norm = jnp.linalg.norm(pval.reshape(-1).astype(jnp.float32))
+        u_norm = jnp.linalg.norm(update.reshape(-1).astype(jnp.float32))
+        trust = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0).astype(pval.dtype)
+        return pval - lr * trust * update
+
+
+class LBFGS(Optimizer):
+    """Minimal LBFGS (reference: python/paddle/optimizer/lbfgs.py) — closure-based."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._max_iter = max_iter
+        self._history_size = history_size
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._history = []  # list of (s, y, rho)
+        self._prev_flat_grad = None
+        self._prev_flat_w = None
+
+    def _flatten(self, vals):
+        return jnp.concatenate([v.reshape(-1) for v in vals])
+
+    def step(self, closure=None):
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure")
+        params = [p for _, p in self._parameters_list()]
+        loss = closure()
+        flat_g = self._flatten([
+            p._grad if p._grad is not None else jnp.zeros_like(p._value)
+            for p in params
+        ]).astype(jnp.float32)
+        flat_w = self._flatten([p._value for p in params]).astype(jnp.float32)
+        if self._prev_flat_grad is not None:
+            s = flat_w - self._prev_flat_w
+            y = flat_g - self._prev_flat_grad
+            ys = float(jnp.dot(y, s))
+            if ys > 1e-10:
+                self._history.append((s, y, 1.0 / ys))
+                if len(self._history) > self._history_size:
+                    self._history.pop(0)
+        q = flat_g
+        alphas = []
+        for s, y, rho in reversed(self._history):
+            a = rho * jnp.dot(s, q)
+            alphas.append(a)
+            q = q - a * y
+        if self._history:
+            s, y, rho = self._history[-1]
+            gamma = jnp.dot(s, y) / jnp.dot(y, y)
+            q = q * gamma
+        for (s, y, rho), a in zip(self._history, reversed(alphas)):
+            b = rho * jnp.dot(y, q)
+            q = q + (a - b) * s
+        direction = -q
+        lr = self.get_lr()
+        new_w = flat_w + lr * direction
+        offset = 0
+        for p in params:
+            n = p.size
+            p._value = new_w[offset:offset + n].reshape(p._value.shape).astype(p._value.dtype)
+            offset += n
+        self._prev_flat_grad = flat_g
+        self._prev_flat_w = flat_w
+        self._step_count += 1
+        return loss

@@ -1,0 +1,339 @@
+"""The Tensor facade.
+
+Reference parity: `paddle.Tensor` (eager tensor bound in paddle/fluid/pybind/eager.cc,
+method surface in python/paddle/tensor/). TPU-native design: a thin Python wrapper around a
+`jax.Array` (or a jax tracer, under jit) carrying autograd metadata for the tape. All
+compute methods are monkey-patched in by `paddle_tpu.ops` at import time — exactly the
+reference's `monkey_patch_tensor` approach — so op code lives in one place and works for
+both free functions and methods.
+
+Key semantic choices:
+- `stop_gradient` defaults to True (paddle semantics; framework-created Parameters set it
+  False).
+- `shape` returns a list (paddle returns list, not tuple).
+- In-place ops rebind `_value` (functional under the hood; XLA has no aliasing anyway).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .autograd import tape
+from .framework import dtype as _dtype_mod
+
+
+class Tensor:
+    __slots__ = (
+        "_value",
+        "stop_gradient",
+        "_grad",
+        "_grad_node",
+        "_grad_index",
+        "name",
+        "_dist_attr",
+        "persistable",
+        "_hooks",
+        "__weakref__",
+    )
+
+    _iid = 0
+
+    def __init__(self, value, stop_gradient: bool = True, name: str | None = None):
+        if isinstance(value, Tensor):
+            value = value._value
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self._grad = None  # raw jnp array
+        self._grad_node = None
+        self._grad_index = 0
+        self._dist_attr = None  # (mesh, placements) once sharded
+        self.persistable = False
+        self._hooks = None
+        if name is None:
+            Tensor._iid += 1
+            name = f"tensor_{Tensor._iid}"
+        self.name = name
+
+    # ------------------------------------------------------------------ basic properties
+    @property
+    def value(self):
+        return self._value
+
+    @property
+    def shape(self) -> list:
+        return list(self._value.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._value.ndim
+
+    ndimension = dim = lambda self: self._value.ndim
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def place(self):
+        from .framework import device as _device
+
+        devs = getattr(self._value, "devices", None)
+        if callable(devs):
+            try:
+                ds = list(self._value.devices())
+                if ds:
+                    return _device.Place(ds[0])
+            except Exception:
+                pass
+        return _device.get_place()
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._grad_node is None
+
+    @property
+    def grad(self):
+        if self._grad is None:
+            return None
+        g = Tensor(self._grad, stop_gradient=True, name=self.name + "@GRAD")
+        return g
+
+    @grad.setter
+    def grad(self, g):
+        if g is None:
+            self._grad = None
+        else:
+            self._grad = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+
+    def _accumulate_grad(self, g):
+        if self._hooks:
+            for h in self._hooks:
+                out = h(Tensor(g, stop_gradient=True))
+                if out is not None:
+                    g = out._value if isinstance(out, Tensor) else out
+        if self._grad is None:
+            self._grad = g
+        else:
+            self._grad = self._grad + g
+
+    def register_hook(self, hook):
+        """Hook runs on the gradient when it is accumulated into this tensor."""
+        if self._hooks is None:
+            self._hooks = []
+        self._hooks.append(hook)
+
+        class _Removable:
+            def __init__(self, owner, fn):
+                self._owner, self._fn = owner, fn
+
+            def remove(self):
+                try:
+                    self._owner._hooks.remove(self._fn)
+                except ValueError:
+                    pass
+
+        return _Removable(self, hook)
+
+    # ------------------------------------------------------------------ conversion
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        if args:
+            return np.asarray(self._value).item(*args)
+        return np.asarray(self._value).item()
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._value)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(np.asarray(self._value))
+
+    def __int__(self):
+        return int(np.asarray(self._value))
+
+    def __bool__(self):
+        return bool(np.asarray(self._value))
+
+    def __index__(self):
+        return int(np.asarray(self._value))
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __hash__(self):
+        return id(self)
+
+    # ------------------------------------------------------------------ autograd
+    def backward(self, grad_tensor=None, retain_graph=False):
+        tape.backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self._grad = None
+
+    def clear_gradient(self, set_to_zero: bool = False):
+        if set_to_zero and self._grad is not None:
+            self._grad = jnp.zeros_like(self._grad)
+        else:
+            self._grad = None
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._value, stop_gradient=True, name=self.name + ".detach")
+        t._dist_attr = self._dist_attr
+        return t
+
+    def detach_(self) -> "Tensor":
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        from .ops import apply_op
+
+        return apply_op(lambda x: x + 0, "clone", self)
+
+    # ------------------------------------------------------------------ in-place plumbing
+    def _replace_(self, new_value):
+        """In-place semantic: rebind the payload. Autograd history is cut (paddle's
+        in-place ops on leaves with grad raise; we follow the pragmatic route used by
+        optimizers which run under no_grad)."""
+        self._value = new_value
+        return self
+
+    def copy_(self, other, blocking=True):
+        src = other._value if isinstance(other, Tensor) else jnp.asarray(other)
+        self._value = jnp.broadcast_to(src, self._value.shape).astype(self._value.dtype)
+        return self
+
+    def set_value(self, value):
+        src = value._value if isinstance(value, Tensor) else jnp.asarray(np.asarray(value))
+        self._value = src.astype(self._value.dtype).reshape(self._value.shape)
+        return self
+
+    def get_tensor(self):
+        return self
+
+    # ------------------------------------------------------------------ misc reference API
+    def pin_memory(self):
+        return self
+
+    def cpu(self):
+        cpu_dev = jax.devices("cpu")[0] if _safe_cpu() else None
+        if cpu_dev is not None and not _is_tracer(self._value):
+            return Tensor(jax.device_put(self._value, cpu_dev), self.stop_gradient)
+        return self
+
+    def cuda(self, device_id=0):
+        if not _is_tracer(self._value):
+            return Tensor(jax.device_put(self._value, jax.devices()[0]), self.stop_gradient)
+        return self
+
+    def to(self, *args, **kwargs):
+        from .ops import creation
+
+        dtype = kwargs.get("dtype")
+        device = kwargs.get("device")
+        for a in args:
+            if isinstance(a, str) and a in _dtype_mod._STR_ALIASES:
+                dtype = a
+            elif isinstance(a, str):
+                device = a
+            elif isinstance(a, (np.dtype,)) or hasattr(a, "itemsize"):
+                dtype = a
+        out = self
+        if dtype is not None:
+            out = out.astype(dtype)
+        if device is not None and not _is_tracer(out._value):
+            if str(device).startswith("cpu") and _safe_cpu():
+                out = Tensor(jax.device_put(out._value, jax.devices("cpu")[0]), out.stop_gradient)
+        return out
+
+    def __repr__(self):
+        sg = self.stop_gradient
+        if _is_tracer(self._value):
+            return f"Tensor(shape={self.shape}, dtype={self.dtype.name}, tracer={self._value!r})"
+        vals = np.asarray(self._value)
+        return (
+            f"Tensor(shape={self.shape}, dtype={_dtype_mod.dtype_to_str(self.dtype)}, "
+            f"place={self.place}, stop_gradient={sg},\n       {vals})"
+        )
+
+    __str__ = __repr__
+
+    # Iteration (rows)
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _safe_cpu() -> bool:
+    try:
+        jax.devices("cpu")
+        return True
+    except RuntimeError:
+        return False
+
+
+# jax pytree registration: Tensors flatten to their payload so they can cross jit
+# boundaries and live inside optimizer state pytrees. NOTE: `name` is intentionally NOT
+# part of the aux data — per-instance names would defeat jit signature caching.
+jax.tree_util.register_pytree_node(
+    Tensor,
+    lambda t: ((t._value,), (t.stop_gradient,)),
+    lambda aux, children: Tensor(children[0], stop_gradient=aux[0], name="_pt"),
+)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor.
+
+    Python floats / float lists default to get_default_dtype() (float32), matching the
+    reference (python/paddle/tensor/creation.py to_tensor); numpy arrays keep their dtype.
+    """
+    dtype = _dtype_mod.convert_dtype(dtype)
+    if isinstance(data, Tensor):
+        v = data._value
+        if dtype is not None and v.dtype != dtype:
+            v = v.astype(dtype)
+        return Tensor(v, stop_gradient=stop_gradient)
+    if isinstance(data, (jnp.ndarray, jax.Array)) or _is_tracer(data):
+        v = data
+        if dtype is not None and v.dtype != dtype:
+            v = v.astype(dtype)
+        return Tensor(v, stop_gradient=stop_gradient)
+    arr = np.asarray(data)
+    if dtype is None:
+        if arr.dtype == np.float64 and not isinstance(data, np.ndarray) and not (
+            isinstance(data, (list, tuple)) and _contains_ndarray(data)
+        ):
+            # python floats / lists of floats → default dtype
+            dtype = _dtype_mod.get_default_dtype()
+        elif arr.dtype == np.float64 and isinstance(data, np.ndarray):
+            dtype = np.float64
+    v = jnp.asarray(arr, dtype=dtype)
+    return Tensor(v, stop_gradient=stop_gradient)
+
+
+def _contains_ndarray(seq):
+    for x in seq:
+        if isinstance(x, np.ndarray):
+            return True
+        if isinstance(x, (list, tuple)) and _contains_ndarray(x):
+            return True
+    return False

@@ -1,0 +1,4 @@
+"""paddle.vision. Reference: python/paddle/vision/."""
+from . import models  # noqa: F401
+from . import ops  # noqa: F401
+from . import transforms  # noqa: F401

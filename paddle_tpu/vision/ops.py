@@ -1,0 +1,351 @@
+"""Detection ops: nms / roi_align / yolo_box / deform_conv2d.
+Reference: python/paddle/vision/ops.py (:1934 nms, :1705 roi_align, :277 yolo_box,
+:766 deform_conv2d). These are the PP-YOLOE dependency set; data-dependent-shape ops run
+their selection logic on host (documented dynamic boundary, SURVEY.md §7.3.5)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import apply_op
+from ..tensor import Tensor
+
+__all__ = ["nms", "roi_align", "roi_pool", "box_coder", "yolo_box", "yolo_loss",
+           "deform_conv2d", "DeformConv2D", "distribute_fpn_proposals",
+           "generate_proposals", "PSRoIPool", "RoIAlign", "RoIPool"]
+
+
+def _iou_matrix(boxes):
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    areas = (x2 - x1) * (y2 - y1)
+    xx1 = np.maximum(x1[:, None], x1[None, :])
+    yy1 = np.maximum(y1[:, None], y1[None, :])
+    xx2 = np.minimum(x2[:, None], x2[None, :])
+    yy2 = np.minimum(y2[:, None], y2[None, :])
+    inter = np.clip(xx2 - xx1, 0, None) * np.clip(yy2 - yy1, 0, None)
+    return inter / np.maximum(areas[:, None] + areas[None, :] - inter, 1e-10)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None, categories=None,
+        top_k=None):
+    """Reference ops.py:1934. Host-side greedy NMS (data-dependent output size)."""
+    b = np.asarray(boxes._value if isinstance(boxes, Tensor) else boxes, np.float32)
+    n = b.shape[0]
+    if scores is None:
+        order = np.arange(n)
+    else:
+        s = np.asarray(scores._value if isinstance(scores, Tensor) else scores)
+        order = np.argsort(-s)
+    if category_idxs is not None:
+        cats = np.asarray(category_idxs._value if isinstance(category_idxs, Tensor)
+                          else category_idxs)
+        # offset boxes per category so cross-category boxes never suppress each other
+        offset = (b.max() + 1.0) * cats.astype(np.float32)
+        b = b + offset[:, None]
+    iou = _iou_matrix(b)
+    keep = []
+    suppressed = np.zeros(n, bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        suppressed |= iou[i] > iou_threshold
+        suppressed[i] = True  # self-suppress so it's not revisited; kept already
+    keep = np.asarray(keep, np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(keep))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0, sampling_ratio=-1,
+              aligned=True, name=None):
+    """Reference ops.py:1705. Bilinear-sampled ROI pooling — vectorized gather."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def f(feat, rois, rois_num):
+        # assign each roi its batch index from boxes_num
+        n_rois = rois.shape[0]
+        batch_idx = jnp.repeat(jnp.arange(rois_num.shape[0]), rois_num, axis=0,
+                               total_repeat_length=n_rois)
+        offset = 0.5 if aligned else 0.0
+        x1 = rois[:, 0] * spatial_scale - offset
+        y1 = rois[:, 1] * spatial_scale - offset
+        x2 = rois[:, 2] * spatial_scale - offset
+        y2 = rois[:, 3] * spatial_scale - offset
+        rw = jnp.maximum(x2 - x1, 1e-3 if aligned else 1.0)
+        rh = jnp.maximum(y2 - y1, 1e-3 if aligned else 1.0)
+        sr = sampling_ratio if sampling_ratio > 0 else 2
+        # sample grid: [n_rois, oh*sr, ow*sr]
+        ys = y1[:, None] + (jnp.arange(oh * sr) + 0.5) / (oh * sr) * rh[:, None]
+        xs = x1[:, None] + (jnp.arange(ow * sr) + 0.5) / (ow * sr) * rw[:, None]
+        H, W = feat.shape[2], feat.shape[3]
+
+        def bilinear(fmap, yy, xx):
+            y0 = jnp.floor(yy)
+            x0 = jnp.floor(xx)
+            y1i = jnp.clip(y0.astype(jnp.int32), 0, H - 1)
+            x1i = jnp.clip(x0.astype(jnp.int32), 0, W - 1)
+            y2i = jnp.clip(y1i + 1, 0, H - 1)
+            x2i = jnp.clip(x1i + 1, 0, W - 1)
+            wy = yy - y0
+            wx = xx - x0
+            v11 = fmap[:, y1i, :][:, :, x1i]
+            v12 = fmap[:, y1i, :][:, :, x2i]
+            v21 = fmap[:, y2i, :][:, :, x1i]
+            v22 = fmap[:, y2i, :][:, :, x2i]
+            return (v11 * (1 - wy)[None, :, None] * (1 - wx)[None, None, :]
+                    + v12 * (1 - wy)[None, :, None] * wx[None, None, :]
+                    + v21 * wy[None, :, None] * (1 - wx)[None, None, :]
+                    + v22 * wy[None, :, None] * wx[None, None, :])
+
+        def per_roi(bi, yy, xx):
+            fmap = feat[bi]  # [C,H,W]
+            sampled = bilinear(fmap, yy, xx)  # [C, oh*sr, ow*sr]
+            C = sampled.shape[0]
+            pooled = sampled.reshape(C, oh, sr, ow, sr).mean(axis=(2, 4))
+            return pooled
+
+        return jax.vmap(per_roi)(batch_idx, ys, xs)
+
+    return apply_op(f, "roi_align", x, boxes, boxes_num)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    return roi_align(x, boxes, boxes_num, output_size, spatial_scale, sampling_ratio=1,
+                     aligned=False)
+
+
+class RoIAlign:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self.output_size, self.spatial_scale,
+                         aligned=aligned)
+
+
+class RoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size, self.spatial_scale)
+
+
+class PSRoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        raise NotImplementedError("PSRoIPool lands with the detection pass")
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0, name=None):
+    def f(pb, pbv, tb):
+        norm = 0.0 if box_normalized else 1.0
+        pw = pb[:, 2] - pb[:, 0] + norm
+        ph = pb[:, 3] - pb[:, 1] + norm
+        pcx = pb[:, 0] + pw * 0.5
+        pcy = pb[:, 1] + ph * 0.5
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + norm
+            th = tb[:, 3] - tb[:, 1] + norm
+            tcx = tb[:, 0] + tw * 0.5
+            tcy = tb[:, 1] + th * 0.5
+            dx = (tcx - pcx) / pw
+            dy = (tcy - pcy) / ph
+            dw = jnp.log(tw / pw)
+            dh = jnp.log(th / ph)
+            out = jnp.stack([dx, dy, dw, dh], axis=-1)
+            if pbv is not None:
+                out = out / pbv
+            return out
+        # decode_center_size
+        d = tb
+        if pbv is not None:
+            d = d * pbv
+        if d.ndim == 2:
+            d = d[:, None, :]
+        cx = d[..., 0] * pw[:, None] + pcx[:, None]
+        cy = d[..., 1] * ph[:, None] + pcy[:, None]
+        w = jnp.exp(d[..., 2]) * pw[:, None]
+        h = jnp.exp(d[..., 3]) * ph[:, None]
+        return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2 - norm, cy + h / 2 - norm],
+                         axis=-1).squeeze()
+
+    return apply_op(f, "box_coder", prior_box, prior_box_var, target_box)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, name=None, scale_x_y=1.0, iou_aware=False,
+             iou_aware_factor=0.5):
+    """Reference ops.py:277 — decode YOLO head output into boxes+scores."""
+
+    def f(xv, imgs):
+        n, c, h, w = xv.shape
+        na = len(anchors) // 2
+        an = jnp.asarray(np.asarray(anchors, np.float32).reshape(na, 2))
+        pred = xv.reshape(n, na, -1, h, w)
+        gx = jnp.arange(w, dtype=jnp.float32)
+        gy = jnp.arange(h, dtype=jnp.float32)
+        cx = (jax.nn.sigmoid(pred[:, :, 0]) * scale_x_y - (scale_x_y - 1) / 2 +
+              gx[None, None, None, :]) / w
+        cy = (jax.nn.sigmoid(pred[:, :, 1]) * scale_x_y - (scale_x_y - 1) / 2 +
+              gy[None, None, :, None]) / h
+        bw = jnp.exp(pred[:, :, 2]) * an[None, :, 0, None, None] / (w * downsample_ratio)
+        bh = jnp.exp(pred[:, :, 3]) * an[None, :, 1, None, None] / (h * downsample_ratio)
+        obj = jax.nn.sigmoid(pred[:, :, 4])
+        cls = jax.nn.sigmoid(pred[:, :, 5:5 + class_num])
+        obj = jnp.where(obj < conf_thresh, 0.0, obj)
+        imgh = imgs[:, 0].astype(jnp.float32)[:, None, None, None]
+        imgw = imgs[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (cx - bw / 2) * imgw
+        y1 = (cy - bh / 2) * imgh
+        x2 = (cx + bw / 2) * imgw
+        y2 = (cy + bh / 2) * imgh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0)
+            y1 = jnp.clip(y1, 0)
+            x2 = jnp.minimum(x2, imgw - 1)
+            y2 = jnp.minimum(y2, imgh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(n, -1, 4)
+        scores = (obj[:, :, None] * cls).transpose(0, 1, 3, 4, 2).reshape(
+            n, -1, class_num)
+        return boxes, scores
+
+    return apply_op(f, "yolo_box", x, img_size)
+
+
+def yolo_loss(*args, **kwargs):
+    raise NotImplementedError("yolo_loss lands with the detection training pass")
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0, dilation=1,
+                  deformable_groups=1, groups=1, mask=None, name=None):
+    """Reference ops.py:766 (DCNv1/v2). Gather-based implementation: sample input at
+    offset positions then 1x1-matmul with the kernel — maps to gathers + one MXU matmul."""
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    ph, pw = (padding, padding) if isinstance(padding, int) else padding
+    dh, dw = (dilation, dilation) if isinstance(dilation, int) else dilation
+
+    def f(xv, off, w, b, m):
+        n, cin, H, W = xv.shape
+        cout, cin_g, kh, kw = w.shape
+        oh = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+        ow = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        xp = jnp.pad(xv, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+        Hp, Wp = xp.shape[2], xp.shape[3]
+        # offsets: [N, 2*dg*kh*kw, oh, ow]
+        off = off.reshape(n, deformable_groups, 2, kh * kw, oh, ow)
+        oy = off[:, :, 0].reshape(n, deformable_groups, kh, kw, oh, ow)
+        ox = off[:, :, 1].reshape(n, deformable_groups, kh, kw, oh, ow)
+        # sample positions per output pixel & kernel tap
+        yy = (jnp.arange(oh) * sh)[None, None, None, None, :, None] + \
+             (jnp.arange(kh) * dh)[None, None, :, None, None, None] + oy
+        xx = (jnp.arange(ow) * sw)[None, None, None, None, None, :] + \
+             (jnp.arange(kw) * dw)[None, None, None, :, None, None] + ox
+        y0 = jnp.floor(yy)
+        x0 = jnp.floor(xx)
+        wy = yy - y0
+        wx = xx - x0
+
+        def gather_at(yi, xi):
+            yi = jnp.clip(yi.astype(jnp.int32), 0, Hp - 1)
+            xi = jnp.clip(xi.astype(jnp.int32), 0, Wp - 1)
+            flat = xp.reshape(n, cin, -1)
+            lin = yi * Wp + xi  # [n, dg, kh, kw, oh, ow]
+            cg = cin // deformable_groups
+            out = []
+            for g in range(deformable_groups):
+                idx = lin[:, g].reshape(n, -1)
+                vals = jnp.take_along_axis(
+                    flat[:, g * cg:(g + 1) * cg], idx[:, None, :], axis=2
+                )
+                out.append(vals.reshape(n, cg, kh, kw, oh, ow))
+            return jnp.concatenate(out, axis=1)
+
+        w11 = (1 - wy) * (1 - wx)
+        w12 = (1 - wy) * wx
+        w21 = wy * (1 - wx)
+        w22 = wy * wx
+
+        def expand_w(wv):
+            return jnp.repeat(wv, cin // deformable_groups, axis=1)
+
+        sampled = (gather_at(y0, x0) * expand_w(w11) + gather_at(y0, x0 + 1) * expand_w(w12)
+                   + gather_at(y0 + 1, x0) * expand_w(w21)
+                   + gather_at(y0 + 1, x0 + 1) * expand_w(w22))
+        if m is not None:
+            mm = m.reshape(n, deformable_groups, kh, kw, oh, ow)
+            sampled = sampled * expand_w(mm)
+        # contract: out[n,co,oh,ow] = sum_{ci,kh,kw} sampled * w (one MXU matmul)
+        if groups == 1:
+            out = jnp.einsum("nckhij,ockh->noij",
+                             sampled.reshape(n, cin, kh, kw, oh, ow), w)
+        else:
+            cg_in = cin // groups
+            cg_out = cout // groups
+            outs = []
+            for g in range(groups):
+                outs.append(jnp.einsum(
+                    "nckhij,ockh->noij",
+                    sampled.reshape(n, cin, kh, kw, oh, ow)[:, g * cg_in:(g + 1) * cg_in],
+                    w[g * cg_out:(g + 1) * cg_out],
+                ))
+            out = jnp.concatenate(outs, axis=1)
+        if b is not None:
+            out = out + b.reshape(1, -1, 1, 1)
+        return out
+
+    return apply_op(f, "deform_conv2d", x, offset, weight, bias, mask)
+
+
+class DeformConv2D:
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, deformable_groups=1, groups=1, weight_attr=None,
+                 bias_attr=None):
+        from ..nn.layer_conv_norm import Conv2D as _C
+
+        helper = _C(in_channels, out_channels, kernel_size, stride=stride,
+                    padding=padding, dilation=dilation, groups=groups,
+                    weight_attr=weight_attr, bias_attr=bias_attr)
+        self.weight = helper.weight
+        self.bias = helper.bias
+        self._args = (stride, padding, dilation, deformable_groups, groups)
+
+    def __call__(self, x, offset, mask=None):
+        s, p, d, dg, g = self._args
+        return deform_conv2d(x, offset, self.weight, self.bias, s, p, d, dg, g, mask)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level, refer_scale,
+                             pixel_offset=False, rois_num=None, name=None):
+    """Reference ops.py:1175 — host-side level assignment (dynamic shapes)."""
+    rois = np.asarray(fpn_rois._value)
+    offset = 1.0 if pixel_offset else 0.0
+    ws = rois[:, 2] - rois[:, 0] + offset
+    hs = rois[:, 3] - rois[:, 1] + offset
+    scale = np.sqrt(ws * hs)
+    levels = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    levels = np.clip(levels, min_level, max_level).astype(np.int64)
+    multi_rois = []
+    restore_parts = []
+    rois_num_per = []
+    for lvl in range(min_level, max_level + 1):
+        idx = np.where(levels == lvl)[0]
+        multi_rois.append(Tensor(jnp.asarray(rois[idx])))
+        rois_num_per.append(Tensor(jnp.asarray(np.asarray([len(idx)], np.int32))))
+        restore_parts.append(idx)
+    order = np.concatenate(restore_parts) if restore_parts else np.zeros(0, np.int64)
+    restore = np.argsort(order).astype(np.int32)
+    return multi_rois, Tensor(jnp.asarray(restore[:, None])), rois_num_per
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000, nms_thresh=0.5,
+                       min_size=0.1, eta=1.0, pixel_offset=False, return_rois_num=False,
+                       name=None):
+    raise NotImplementedError("generate_proposals lands with the detection pass")

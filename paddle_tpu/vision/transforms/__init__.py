@@ -1,0 +1,228 @@
+"""Vision transforms (host-side numpy; the input pipeline runs on CPU).
+Reference: python/paddle/vision/transforms/."""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "RandomCrop", "CenterCrop",
+           "RandomHorizontalFlip", "RandomVerticalFlip", "Transpose", "Pad",
+           "RandomResizedCrop", "BrightnessTransform", "to_tensor", "normalize",
+           "resize", "hflip", "vflip", "center_crop", "crop"]
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+def _as_np(img):
+    return np.asarray(img)
+
+
+def to_tensor(pic, data_format="CHW"):
+    arr = _as_np(pic).astype(np.float32)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if arr.max() > 1.5:
+        arr = arr / 255.0
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    from ...tensor import to_tensor as _tt
+
+    return _tt(arr)
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return to_tensor(img, self.data_format)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    from ...tensor import Tensor
+
+    is_tensor = isinstance(img, Tensor)
+    arr = np.asarray(img._value if is_tensor else img).astype(np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        arr = (arr - mean[:, None, None]) / std[:, None, None]
+    else:
+        arr = (arr - mean) / std
+    if is_tensor:
+        from ...tensor import to_tensor as _tt
+
+        return _tt(arr)
+    return arr
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False, keys=None):
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean, self.std, self.data_format = mean, std, data_format
+
+    def __call__(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+def resize(img, size, interpolation="bilinear"):
+    arr = _as_np(img)
+    if isinstance(size, int):
+        h, w = arr.shape[:2]
+        if h < w:
+            size = (size, int(size * w / h))
+        else:
+            size = (int(size * h / w), size)
+    import jax
+
+    out_shape = (size[0], size[1]) + arr.shape[2:]
+    method = {"bilinear": "bilinear", "nearest": "nearest", "bicubic": "bicubic"}[
+        interpolation]
+    return np.asarray(jax.image.resize(arr.astype(np.float32), out_shape, method=method))
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size, self.interpolation = size, interpolation
+
+    def __call__(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+def crop(img, top, left, height, width):
+    return _as_np(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    arr = _as_np(img)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    h, w = arr.shape[:2]
+    th, tw = output_size
+    top = (h - th) // 2
+    left = (w - tw) // 2
+    return crop(arr, top, left, th, tw)
+
+
+class CenterCrop:
+    def __init__(self, size, keys=None):
+        self.size = size
+
+    def __call__(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop:
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        self.size = (size, size) if isinstance(size, int) else size
+        self.padding = padding
+
+    def __call__(self, img):
+        arr = _as_np(img)
+        if self.padding:
+            p = self.padding
+            arr = np.pad(arr, ((p, p), (p, p)) + ((0, 0),) * (arr.ndim - 2))
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        top = np.random.randint(0, max(h - th, 0) + 1)
+        left = np.random.randint(0, max(w - tw, 0) + 1)
+        return crop(arr, top, left, th, tw)
+
+
+class RandomResizedCrop:
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else size
+        self.scale, self.ratio, self.interpolation = scale, ratio, interpolation
+
+    def __call__(self, img):
+        arr = _as_np(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = np.random.uniform(*self.scale) * area
+            aspect = np.exp(np.random.uniform(np.log(self.ratio[0]), np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target_area * aspect)))
+            ch = int(round(np.sqrt(target_area / aspect)))
+            if cw <= w and ch <= h:
+                top = np.random.randint(0, h - ch + 1)
+                left = np.random.randint(0, w - cw + 1)
+                patch = crop(arr, top, left, ch, cw)
+                return resize(patch, self.size, self.interpolation)
+        return resize(center_crop(arr, min(h, w)), self.size, self.interpolation)
+
+
+def hflip(img):
+    return _as_np(img)[:, ::-1]
+
+
+def vflip(img):
+    return _as_np(img)[::-1]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return hflip(img)
+        return _as_np(img)
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return vflip(img)
+        return _as_np(img)
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def __call__(self, img):
+        arr = _as_np(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return arr.transpose(self.order)
+
+
+class Pad:
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        self.padding = padding
+        self.fill = fill
+
+    def __call__(self, img):
+        arr = _as_np(img)
+        p = self.padding
+        if isinstance(p, int):
+            p = (p, p, p, p)
+        return np.pad(arr, ((p[1], p[3]), (p[0], p[2])) + ((0, 0),) * (arr.ndim - 2),
+                      constant_values=self.fill)
+
+
+class BrightnessTransform:
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def __call__(self, img):
+        arr = _as_np(img).astype(np.float32)
+        alpha = 1 + np.random.uniform(-self.value, self.value)
+        return np.clip(arr * alpha, 0, 255 if arr.max() > 1.5 else 1.0)

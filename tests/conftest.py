@@ -1,0 +1,17 @@
+"""Test harness: run on a virtual 8-device CPU mesh (SURVEY.md §4: the no-hardware
+stand-in for TPU — XLA device-count forcing).
+
+The axon TPU plugin registers itself at interpreter startup via sitecustomize (before
+this file runs), so JAX_PLATFORMS env is already consumed; flip the platform via
+jax.config BEFORE any backend initializes (backends init lazily on first use).
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")

@@ -1,0 +1,154 @@
+"""Autograd tape tests — numeric-vs-analytic gradient checks (mirrors reference
+op_test.py check_grad contract)."""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def numeric_grad(fn, x, eps=1e-3):
+    x = np.asarray(x, np.float64)
+    g = np.zeros_like(x)
+    for i in np.ndindex(x.shape):
+        xp = x.copy()
+        xp[i] += eps
+        xm = x.copy()
+        xm[i] -= eps
+        g[i] = (fn(xp) - fn(xm)) / (2 * eps)
+    return g
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 4, 6])
+
+
+def test_chain():
+    x = paddle.to_tensor([0.5, 1.0], stop_gradient=False)
+    y = paddle.exp(paddle.sin(x)).sum()
+    y.backward()
+    expected = np.cos([0.5, 1.0]) * np.exp(np.sin([0.5, 1.0]))
+    np.testing.assert_allclose(x.grad.numpy(), expected, rtol=1e-5)
+
+
+def test_branching_graph():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    a = x * 3.0
+    b = x * 5.0
+    y = (a + b).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+
+def test_matmul_grad():
+    a_np = np.random.randn(3, 4).astype(np.float32)
+    b_np = np.random.randn(4, 2).astype(np.float32)
+    a = paddle.to_tensor(a_np, stop_gradient=False)
+    b = paddle.to_tensor(b_np, stop_gradient=False)
+    loss = (a @ b).sum()
+    loss.backward()
+    np.testing.assert_allclose(a.grad.numpy(), np.ones((3, 2)) @ b_np.T, rtol=1e-5)
+    np.testing.assert_allclose(b.grad.numpy(), a_np.T @ np.ones((3, 2)), rtol=1e-5)
+
+
+def test_grad_accumulation():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_no_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+
+
+def test_detach():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).detach()
+    assert y.stop_gradient
+    z = x * 2
+    (z + z.detach()).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * x
+    (gx,) = paddle.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), [6.0])
+    assert x.grad is None  # .grad untouched
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3),
+                         stop_gradient=False)
+    parts = paddle.split(x, 3, axis=1)
+    loss = (parts[0] * 1 + parts[2] * 3).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1, 0, 3], [1, 0, 3]])
+
+
+def test_softmax_ce_grad_matches_numeric():
+    logits_np = np.random.randn(4, 5).astype(np.float64)
+    labels_np = np.array([0, 2, 1, 4])
+
+    def f(l):
+        e = np.exp(l - l.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        return -np.log(p[np.arange(4), labels_np]).mean()
+
+    x = paddle.to_tensor(logits_np.astype(np.float32), stop_gradient=False)
+    loss = paddle.nn.functional.cross_entropy(x, paddle.to_tensor(labels_np))
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), numeric_grad(f, logits_np), atol=1e-3)
+
+
+def test_register_hook():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    x.register_hook(lambda g: g * 10)
+    (x * 2).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [20.0])
+
+
+def test_pylayer():
+    class Double(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, grad):
+            return grad * 2
+
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = Double.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(y.numpy(), [6.0])
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_backward_twice_raises():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    try:
+        y.backward()
+        raised = False
+    except RuntimeError:
+        raised = True
+    assert raised
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])
