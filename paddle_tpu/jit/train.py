@@ -67,6 +67,7 @@ class TrainStep:
             if not t.stop_gradient and jnp.issubdtype(t.dtype, jnp.floating)
         }
         self._jitted = None
+        self._compiled = None  # AOT executable installed by aot_prime()
         self._seed = 0
 
     # -------------------------------------------------------------- traced step
@@ -174,20 +175,44 @@ class TrainStep:
                 }
         return acc
 
-    def __call__(self, *args, **kwargs):
+    def _prep_inputs(self, advance: bool):
+        """Build the exact traced-input tuple a step consumes. `advance=True` bumps
+        the step counter / RNG seed (a real step); `advance=False` peeks at what the
+        NEXT call would pass (AOT lowering for audit), mutating nothing."""
         if self._jitted is None:
             self._jitted = self._build()
         inner_opt = getattr(self.optimizer, "_inner_opt", self.optimizer)
         state = {k: t._value for k, t in self._param_tensors.items()}
         acc_state = self._gather_acc_state()
-        inner_opt._step_count += 1
-        self._seed += 1
-        key = jax.random.fold_in(_rng.default_generator()._key, self._seed)
-        step_i = jnp.asarray(inner_opt._step_count, jnp.int32)
+        if advance:
+            inner_opt._step_count += 1
+            self._seed += 1
+            seed, step_count = self._seed, inner_opt._step_count
+        else:
+            seed, step_count = self._seed + 1, inner_opt._step_count + 1
+        key = jax.random.fold_in(_rng.default_generator()._key, seed)
+        step_i = jnp.asarray(step_count, jnp.int32)
         lr = jnp.asarray(inner_opt.get_lr(), jnp.float32)
-        loss_val, new_state, new_acc = self._jitted(
-            state, acc_state, step_i, lr, key, args, kwargs
-        )
+        return inner_opt, (state, acc_state, step_i, lr, key)
+
+    def lowered(self, *args, **kwargs):
+        """AOT-lower the compiled step for the same (args, kwargs) a __call__ would
+        see — for `compile().cost_analysis()` (FLOPs/MFU audit) without executing a
+        step or mutating optimizer bookkeeping."""
+        _, traced = self._prep_inputs(advance=False)
+        return self._jitted.lower(*traced, args, kwargs)
+
+    def aot_prime(self, *args, **kwargs):
+        """Compile once ahead-of-time and install the executable so subsequent
+        __call__s reuse it (avoids the separate jit-cache compile). Returns the
+        jax compiled object (cost_analysis(), as_text())."""
+        self._compiled = self.lowered(*args, **kwargs).compile()
+        return self._compiled
+
+    def __call__(self, *args, **kwargs):
+        inner_opt, traced = self._prep_inputs(advance=True)
+        fn = self._compiled if self._compiled is not None else self._jitted
+        loss_val, new_state, new_acc = fn(*traced, args, kwargs)
         # write back into live objects
         for k, t in self._param_tensors.items():
             t._value = new_state[k]
