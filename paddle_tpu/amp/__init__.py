@@ -112,6 +112,10 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        # optimizers already unscaled this step (reference OptimizerState tracking:
+        # grad_scaler.py) — prevents double division when the user calls unscale_
+        # manually before step() (the standard AMP + grad-clip pattern)
+        self._unscaled_opts: set[int] = set()
 
     def scale(self, loss):
         if not self._enable:
@@ -119,8 +123,9 @@ class GradScaler:
         return loss * self._scale
 
     def unscale_(self, optimizer):
-        if not self._enable:
+        if not self._enable or id(optimizer) in self._unscaled_opts:
             return
+        self._unscaled_opts.add(id(optimizer))
         inv = 1.0 / self._scale
         found = False
         for _, p in optimizer._parameters_list():
@@ -159,6 +164,7 @@ class GradScaler:
                 self._scale *= self._incr_ratio
                 self._good_steps = 0
         self._found_inf = False
+        self._unscaled_opts.clear()
 
     def is_enable(self):
         return self._enable

@@ -97,8 +97,14 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     ax = _axis(group)
     if _in_trace(v) and ax is not None:
         fns = {"sum": jax.lax.psum, "max": jax.lax.pmax, "min": jax.lax.pmin,
-               "avg": jax.lax.pmean}
-        tensor._value = fns[op if isinstance(op, str) else "sum"](v, ax)
+               "avg": jax.lax.pmean,
+               # no lax.pprod primitive: product = exp(psum(log)) would lose sign,
+               # so reduce via all_gather + prod along the gathered axis
+               "prod": lambda x, a: jnp.prod(jax.lax.all_gather(x, a), axis=0)}
+        key = op if isinstance(op, str) else "sum"
+        if key not in fns:
+            raise NotImplementedError(f"all_reduce op {op!r} not supported")
+        tensor._value = fns[key](v, ax)
         return tensor
     # eager single-process world: identity (world size 1 per process under TPU SPMD)
     return tensor

@@ -102,18 +102,30 @@ class TrainStep:
             def loss_from(trainable_state):
                 full = dict(state)
                 full.update(trainable_state)
+                mutated: dict = {}
                 with _rng.trace_key(key), tape.no_grad():
-                    out = model.functional_call(full, *model_args, **kwargs)
+                    out = model.functional_call(
+                        full, *model_args, _capture_mutations=mutated, **kwargs
+                    )
                     if label is not None:
                         loss_t = loss_fn(out, label)
                     elif isinstance(out, (tuple, list)):
                         loss_t = loss_fn(*out)
                     else:
                         loss_t = loss_fn(out)
-                return loss_t._value if isinstance(loss_t, Tensor) else loss_t
+                loss_v = loss_t._value if isinstance(loss_t, Tensor) else loss_t
+                # buffer updates (BN running mean/var) flow out as aux so they
+                # survive functional_call's state restore
+                buffers = {
+                    k: (v._value if isinstance(v, Tensor) else v)
+                    for k, v in mutated.items() if k not in trainable_keys
+                }
+                return loss_v, buffers
 
             trainable_state = {k: state[k] for k in trainable_keys}
-            loss_val, grads = jax.value_and_grad(loss_from)(trainable_state)
+            (loss_val, new_buffers), grads = jax.value_and_grad(
+                loss_from, has_aux=True
+            )(trainable_state)
             grads = _functional_clip(inner_opt._grad_clip, grads,
                                      trainable_state)
             # run optimizer update rules traced: swap accumulator store
@@ -151,6 +163,7 @@ class TrainStep:
             finally:
                 inner_opt._accumulators = saved_acc
                 inner_opt._step_count = saved_step
+            new_state.update(new_buffers)
             return loss_val, new_state, new_acc
 
         return jax.jit(step_fn, donate_argnums=(0, 1))

@@ -27,6 +27,11 @@ def linear(x, weight, bias=None, name=None):
 
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
     if not training or (isinstance(p, (int, float)) and p == 0):
+        if mode == "downscale_in_infer" and not training and p:
+            # reference semantics: train path masks without scaling, so inference
+            # must scale by the keep probability
+            return apply_op(lambda v: (v * (1.0 - float(p))).astype(v.dtype),
+                            "dropout", x)
         return x if isinstance(x, Tensor) else Tensor(x)
     pv = float(p)
 

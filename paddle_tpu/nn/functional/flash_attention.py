@@ -89,13 +89,16 @@ def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax
     scale = 1.0 / math.sqrt(head_dim)
 
     if _use_pallas(tuple(query.shape), query.dtype) and not dropout:
-        from ...ops.pallas.flash_attention import flash_attention_fwd
-
-        out = apply_op(
-            lambda q, k, v: flash_attention_fwd(q, k, v, causal=causal, scale=scale),
-            "flash_attention_pallas", query, key, value,
-        )
-        return (out, None) if return_softmax else (out, None)
+        try:
+            from ...ops.pallas.flash_attention import flash_attention as _pallas_fa
+        except ImportError:
+            _pallas_fa = None
+        if _pallas_fa is not None:
+            out = apply_op(
+                lambda q, k, v: _pallas_fa(q, k, v, causal=causal, scale=scale),
+                "flash_attention_pallas", query, key, value,
+            )
+            return out, None
 
     out = apply_op(
         lambda q, k, v: _sdpa_core(q, k, v, None, scale, causal, dropout, training),

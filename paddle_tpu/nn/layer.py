@@ -330,10 +330,15 @@ class Layer:
             if k in sd:
                 sd[k]._value = v
 
-    def functional_call(self, raw_state: dict, *args, **kwargs):
+    def functional_call(self, raw_state: dict, *args, _capture_mutations=None, **kwargs):
         """Run forward with parameter payloads replaced by `raw_state` values (tracers
         allowed). Restores original payloads afterwards. This is what jit/grad close
-        over — the TPU-native compiled path."""
+        over — the TPU-native compiled path.
+
+        `_capture_mutations`: optional dict filled with {name: new_value} for state
+        entries the forward reassigned in place (batch-norm running mean/var). The
+        compiled TrainStep threads these out as aux outputs so running statistics
+        survive the restore below."""
         sd = self.state_dict()
         saved = {k: t._value for k, t in sd.items()}
         saved_sg = {k: t.stop_gradient for k, t in sd.items()}
@@ -343,6 +348,11 @@ class Layer:
                     sd[k]._value = v
                     sd[k].stop_gradient = True  # tape off inside functional path
             out = self(*args, **kwargs)
+            if _capture_mutations is not None:
+                for k, t in sd.items():
+                    set_to = raw_state.get(k, saved[k])
+                    if t._value is not set_to:
+                        _capture_mutations[k] = t._value
             return out
         finally:
             for k, t in sd.items():

@@ -1,0 +1,87 @@
+"""Regression tests for round-1 advisor findings (ADVICE.md)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.jit.train import TrainStep
+
+
+def test_bn_running_stats_update_through_trainstep():
+    """functional_call restores state; BN running mean/var must still flow out of
+    the compiled step (advisor: medium, nn/layer.py functional_call)."""
+    paddle.seed(0)
+    model = nn.Sequential(
+        nn.Conv2D(3, 8, 3, padding=1), nn.BatchNorm2D(8), nn.ReLU(),
+        nn.AdaptiveAvgPool2D(1), nn.Flatten(), nn.Linear(8, 4),
+    )
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    lf = nn.CrossEntropyLoss()
+    step = TrainStep(model, lambda o, y: lf(o, y), opt)
+    bn = model[1]
+    m0 = np.asarray(bn._mean._value).copy()
+    x = paddle.to_tensor(np.random.randn(8, 3, 16, 16).astype("float32") * 3 + 1)
+    y = paddle.to_tensor(np.random.randint(0, 4, 8).astype("int64"))
+    for _ in range(3):
+        step(x, y)
+    m1 = np.asarray(bn._mean._value)
+    assert not np.allclose(m0, m1)
+    v1 = np.asarray(bn._variance._value)
+    assert not np.allclose(v1, np.ones_like(v1))
+
+
+def test_gradscaler_manual_unscale_then_step_no_double_division():
+    sc = paddle.amp.GradScaler(init_loss_scaling=128.0)
+    lin = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.0, parameters=lin.parameters())
+    out = lin(paddle.to_tensor(np.ones((2, 4), "float32")))
+    sc.scale(out.sum()).backward()
+    sc.unscale_(opt)
+    g1 = np.asarray(lin.weight.grad._value).copy()
+    sc.step(opt)
+    g2 = np.asarray(lin.weight.grad._value)
+    np.testing.assert_allclose(g1, g2)
+    sc.update()
+    # next step unscales again
+    opt.clear_grad()
+    sc.scale(lin(paddle.to_tensor(np.ones((2, 4), "float32"))).sum()).backward()
+    sc.unscale_(opt)
+    g3 = np.asarray(lin.weight.grad._value)
+    np.testing.assert_allclose(g3, g1)
+
+
+def test_dropout_downscale_in_infer_eval_scaling():
+    x = paddle.to_tensor(np.ones((4, 4), "float32"))
+    o = nn.functional.dropout(x, p=0.25, training=False, mode="downscale_in_infer")
+    np.testing.assert_allclose(np.asarray(o._value), 0.75)
+    # upscale_in_train mode: eval is identity
+    o2 = nn.functional.dropout(x, p=0.25, training=False)
+    np.testing.assert_allclose(np.asarray(o2._value), 1.0)
+
+
+def test_flash_attention_no_dead_import():
+    q = paddle.to_tensor(np.random.randn(1, 64, 2, 16).astype("float32"))
+    out, _ = nn.functional.flash_attention(q, q, q, causal=True)
+    assert tuple(out.shape) == (1, 64, 2, 16)
+
+
+def test_all_reduce_prod_in_trace():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    import paddle_tpu.distributed as dist
+
+    devs = np.array(jax.devices()[:4])
+    mesh = Mesh(devs, ("x",))
+    g = dist.collective.Group(ranks=list(range(4)), axis_name="x")
+
+    def f(v):
+        t = paddle.Tensor(v.reshape(()))
+        dist.all_reduce(t, op=dist.ReduceOp.PROD, group=g)
+        return t._value.reshape(1)
+
+    vals = jnp.asarray([1.0, 2.0, -3.0, 4.0])
+    out = jax.jit(shard_map(f, mesh=mesh, in_specs=P("x"), out_specs=P("x")))(vals)
+    np.testing.assert_allclose(np.asarray(out), -24.0)
