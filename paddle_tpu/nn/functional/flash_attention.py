@@ -38,7 +38,7 @@ def sdp_kernel(enable_flash=True, enable_math=True, enable_mem_efficient=True):
         _sdp_config.update(prev)
 
 
-def _use_pallas(q_shape, dtype) -> bool:
+def _use_pallas(q_shape, k_shape) -> bool:
     if not _sdp_config["enable_flash"]:
         return False
     try:
@@ -47,9 +47,12 @@ def _use_pallas(q_shape, dtype) -> bool:
         return False
     if dev in ("cpu", "gpu"):
         return False
-    seq = q_shape[1]
-    # pallas pays off when the score matrix stops fitting in VMEM
-    return seq >= 1024 and seq % 128 == 0
+    try:
+        from ...ops.pallas import flash_attention as pfa
+    except ImportError:
+        return False
+    # pallas pays off once the [B,H,S,S] score tensor would round-trip HBM
+    return q_shape[1] >= 1024 and pfa.supports(tuple(q_shape), tuple(k_shape))
 
 
 def _sdpa_core(q, k, v, mask, scale, is_causal, dropout_p, training):
@@ -88,17 +91,14 @@ def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax
     head_dim = query.shape[-1]
     scale = 1.0 / math.sqrt(head_dim)
 
-    if _use_pallas(tuple(query.shape), query.dtype) and not dropout:
-        try:
-            from ...ops.pallas.flash_attention import flash_attention as _pallas_fa
-        except ImportError:
-            _pallas_fa = None
-        if _pallas_fa is not None:
-            out = apply_op(
-                lambda q, k, v: _pallas_fa(q, k, v, causal=causal, scale=scale),
-                "flash_attention_pallas", query, key, value,
-            )
-            return out, None
+    if _use_pallas(tuple(query.shape), tuple(key.shape)) and not dropout:
+        from ...ops.pallas.flash_attention import flash_attention as _pallas_fa
+
+        out = apply_op(
+            lambda q, k, v: _pallas_fa(q, k, v, causal=causal, scale=scale),
+            "flash_attention_pallas", query, key, value,
+        )
+        return out, None
 
     out = apply_op(
         lambda q, k, v: _sdpa_core(q, k, v, None, scale, causal, dropout, training),
@@ -147,6 +147,19 @@ def flashmask_attention(query, key, value, startend_row_indices=None, dropout=0.
     boolean mask; a Pallas blockwise-skip kernel is the optimization path."""
     head_dim = query.shape[-1]
     scale = 1.0 / math.sqrt(head_dim)
+
+    if (startend_row_indices is not None and not dropout
+            and _use_pallas(tuple(query.shape), tuple(key.shape))):
+        from ...ops.pallas.flash_attention import flashmask_attention as _pallas_fm
+
+        out = apply_op(
+            lambda q, k, v, sri: _pallas_fm(q, k, v, sri, causal=causal, scale=scale),
+            "flashmask_attention_pallas", query, key, value, startend_row_indices,
+        )
+        if return_softmax_lse or return_seed_offset:
+            extras = [None] * (int(return_softmax_lse) + int(return_seed_offset))
+            return (out, *extras)
+        return out
 
     def f(q, k, v, sri):
         B, S = q.shape[0], q.shape[1]

@@ -1,0 +1,7 @@
+"""Pallas TPU kernels — the curated custom-kernel set SURVEY.md §7.1 calls for
+(attention family first; XLA fusion covers the rest of the op surface).
+
+Kernels run compiled on TPU and in interpreter mode elsewhere (CPU CI), so every
+kernel is testable on the virtual-device mesh without hardware.
+"""
+from . import flash_attention  # noqa: F401

@@ -1,0 +1,379 @@
+"""Flash attention (+ FlashMask) as Pallas TPU kernels.
+
+Reference parity surface: python/paddle/nn/functional/flash_attention.py:358
+(flash_attention), :1299 (flashmask_attention startend_row_indices encoding).
+The reference binds an external CUDA flashattn library; here the kernel is
+TPU-native Pallas (MXU matmuls, VMEM-resident K/V, f32 accumulation).
+
+Design: grid over (batch*heads, q_blocks). Each grid step loads one q block
+[BQ, D] plus the whole K/V [S, D] into VMEM and computes its exact softmax rows
+— no online max/sum rescaling needed, while still never materialising the
+[B, H, S, S] score tensor in HBM (that HBM round-trip is what makes the naive
+path memory-bound at long S). K/V VMEM residency bounds S at ~8K for D=128
+bf16; beyond that the sequence axis is sharded by ring attention
+(paddle_tpu/distributed/context_parallel.py), which calls back into this kernel
+per shard.
+
+Backward is the standard two-kernel flash split: dq over q blocks, dk/dv over
+k blocks, with delta = rowsum(dO * O) precomputed.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+_NEG = float(jnp.finfo(jnp.float32).min)
+
+
+def _interpret() -> bool:
+    try:
+        return jax.default_backend() != "tpu"
+    except Exception:
+        return True
+
+
+def _no_x64():
+    """paddle_tpu enables jax_enable_x64 globally (paddle int64 dtype parity);
+    under x64 pallas' internal index arithmetic emits i64 ops Mosaic cannot
+    legalize. Kernel dtypes here are all explicit, so tracing the pallas_call
+    with x64 off is semantics-preserving."""
+    return jax.enable_x64(False)
+
+
+# --------------------------------------------------------------------------- masks
+def _allowed_mask(rows, cols, sri, causal: bool, seq: int):
+    """(BQ, S) boolean mask of allowed positions; matches the semantics of the
+    naive flashmask path (nn/functional/flash_attention.py flashmask_attention).
+
+    rows/cols: int32 [BQ, S] query-row / key-col indices.
+    sri: None or [S, n] int32 startend_row_indices for this (batch, head).
+    """
+    if causal:
+        allowed = rows >= cols
+    else:
+        allowed = jnp.ones(rows.shape, jnp.bool_)
+    if sri is None:
+        return allowed
+    n = sri.shape[-1]
+    if causal:
+        start = sri[:, 0][None, :]  # per-column mask start row
+        if n == 1:
+            masked = rows >= start
+        else:
+            end = sri[:, 1][None, :]
+            masked = (rows >= start) & (rows < end)
+        return allowed & ~masked
+    lts = sri[:, 0][None, :]
+    lte = sri[:, 1][None, :] if n > 1 else jnp.full_like(lts, seq)
+    uts = sri[:, 2][None, :] if n > 2 else jnp.zeros_like(lts)
+    ute = sri[:, 3][None, :] if n > 3 else jnp.zeros_like(lts)
+    lower = (rows >= lts) & (rows < lte)
+    upper = (rows >= uts) & (rows < ute)
+    return allowed & ~(lower | upper)
+
+
+def _row_col(qi, block_q: int, seq: int):
+    rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, seq), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, seq), 1)
+    return rows, cols
+
+
+# --------------------------------------------------------------------------- fwd
+def _fwd_kernel(*refs, scale, causal, block_q, seq, has_sri):
+    scale = jnp.float32(scale)  # x64 mode: bare python floats promote f32->f64
+    if has_sri:
+        q_ref, k_ref, v_ref, sri_ref, o_ref, lse_ref = refs
+        sri = sri_ref[0]
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref = refs
+        sri = None
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    rows, cols = _row_col(qi, block_q, seq)
+    allowed = _allowed_mask(rows, cols, sri, causal, seq)
+    s = jnp.where(allowed, s, jnp.float32(_NEG))
+    m = jnp.max(s, axis=1, keepdims=True)
+    e = jnp.exp(s - m)
+    l = jnp.sum(e, axis=1, keepdims=True)
+    o = jax.lax.dot_general(e, v, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    o = o / l
+    o_ref[0] = o.astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l)  # (BQ, 1) column — Mosaic-friendly 2D store
+
+
+def _mha_fwd(q, k, v, sri, causal, scale, block_q):
+    """q/k/v: [BH, S, D]; sri: [BH, S, n] int32 or None. Returns (out, lse)."""
+    bh, seq, d = q.shape
+    nq = seq // block_q
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=block_q, seq=seq,
+        has_sri=sri is not None,
+    )
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
+    ]
+    args = [q, k, v]
+    if sri is not None:
+        in_specs.append(pl.BlockSpec((1, seq, sri.shape[-1]), lambda b, i: (b, 0, 0)))
+        args.append(sri)
+    with _no_x64():
+        out, lse = pl.pallas_call(
+            kernel,
+            grid=(bh, nq),
+            in_specs=in_specs,
+            out_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+                # lse as a [BH, S, 1] column: block (1, BQ, 1) is legal TPU tiling
+                # (lane dim equals the array's) and every kernel op stays 2D
+                pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, seq, d), q.dtype),
+                jax.ShapeDtypeStruct((bh, seq, 1), jnp.float32),
+            ],
+            interpret=_interpret(),
+        )(*args)
+    return out, lse.reshape(bh, seq)
+
+
+# --------------------------------------------------------------------------- bwd
+def _dq_kernel(*refs, scale, causal, block_q, seq, has_sri):
+    scale = jnp.float32(scale)
+    if has_sri:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, sri_ref, dq_ref = refs
+        sri = sri_ref[0]
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref = refs
+        sri = None
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]    # (BQ, 1)
+    delta = dl_ref[0]   # (BQ, 1)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    rows, cols = _row_col(qi, block_q, seq)
+    allowed = _allowed_mask(rows, cols, sri, causal, seq)
+    s = jnp.where(allowed, s, jnp.float32(_NEG))
+    p = jnp.exp(s - lse)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale
+    dq = jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(*refs, scale, causal, block_k, seq, has_sri):
+    scale = jnp.float32(scale)
+    if has_sri:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, sri_ref, dk_ref, dv_ref = refs
+        sri_blk = sri_ref[0]
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dk_ref, dv_ref = refs
+        sri_blk = None
+    ki = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)      # (S, D)
+    k = k_ref[0].astype(jnp.float32)      # (BK, D)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)    # (S, D)
+    lse = lse_ref[0]                      # (S, 1)
+    delta = dl_ref[0]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale  # (S, BK)
+    rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    allowed = _allowed_mask(rows, cols, sri_blk, causal, seq)
+    s = jnp.where(allowed, s, jnp.float32(_NEG))
+    p = jnp.exp(s - lse)
+    dv = jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)  # (BK, D)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)  # (S, BK)
+    ds = p * (dp - delta) * scale
+    dk = jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)  # (BK, D)
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _mha_bwd(q, k, v, sri, out, lse, g, causal, scale, block_q):
+    bh, seq, d = q.shape
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    lse = lse.reshape(bh, seq, 1)
+    delta = delta.reshape(bh, seq, 1)
+    nq = seq // block_q
+    has_sri = sri is not None
+
+    dq_in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),   # q
+        pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),       # k
+        pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),       # v
+        pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),   # do
+        pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),   # lse
+        pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),   # delta
+    ]
+    dq_args = [q, k, v, g, lse, delta]
+    if has_sri:
+        dq_in_specs.append(pl.BlockSpec((1, seq, sri.shape[-1]), lambda b, i: (b, 0, 0)))
+        dq_args.append(sri)
+    with _no_x64():
+        dq = pl.pallas_call(
+            functools.partial(_dq_kernel, scale=scale, causal=causal, block_q=block_q,
+                              seq=seq, has_sri=has_sri),
+            grid=(bh, nq),
+            in_specs=dq_in_specs,
+            out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+            interpret=_interpret(),
+        )(*dq_args)
+
+    dkv_in_specs = [
+        pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),       # q
+        pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),   # k block
+        pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),   # v block
+        pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),       # do
+        pl.BlockSpec((1, seq, 1), lambda b, i: (b, 0, 0)),       # lse
+        pl.BlockSpec((1, seq, 1), lambda b, i: (b, 0, 0)),       # delta
+    ]
+    dkv_args = [q, k, v, g, lse, delta]
+    if has_sri:
+        # sri is indexed by key column: this kernel only sees its k block's columns
+        dkv_in_specs.append(
+            pl.BlockSpec((1, block_q, sri.shape[-1]), lambda b, i: (b, i, 0))
+        )
+        dkv_args.append(sri)
+    with _no_x64():
+        dk, dv = pl.pallas_call(
+            functools.partial(_dkv_kernel, scale=scale, causal=causal, block_k=block_q,
+                              seq=seq, has_sri=has_sri),
+            grid=(bh, nq),
+            in_specs=dkv_in_specs,
+            out_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct(k.shape, k.dtype),
+                jax.ShapeDtypeStruct(v.shape, v.dtype),
+            ],
+            interpret=_interpret(),
+        )(*dkv_args)
+    return dq, dk, dv
+
+
+# ------------------------------------------------------------------- custom vjp
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, scale, block_q):
+    out, _ = _mha_fwd(q, k, v, None, causal, scale, block_q)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q):
+    out, lse = _mha_fwd(q, k, v, None, causal, scale, block_q)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, scale, block_q, res, g):
+    q, k, v, out, lse = res
+    return _mha_bwd(q, k, v, None, out, lse, g, causal, scale, block_q)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_masked(q, k, v, sri, causal, scale, block_q):
+    out, _ = _mha_fwd(q, k, v, sri, causal, scale, block_q)
+    return out
+
+
+def _flash_masked_fwd(q, k, v, sri, causal, scale, block_q):
+    out, lse = _mha_fwd(q, k, v, sri, causal, scale, block_q)
+    return out, (q, k, v, sri, out, lse)
+
+
+def _flash_masked_bwd(causal, scale, block_q, res, g):
+    q, k, v, sri, out, lse = res
+    dq, dk, dv = _mha_bwd(q, k, v, sri, out, lse, g, causal, scale, block_q)
+    dsri = np.zeros(sri.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, dsri
+
+
+_flash_masked.defvjp(_flash_masked_fwd, _flash_masked_bwd)
+
+
+# ------------------------------------------------------------------ public API
+def _to_bhsd(x):
+    """[B, S, H, D] -> [B*H, S, D] (paddle flash layout -> kernel layout)."""
+    b, s, h, d = x.shape
+    return jnp.swapaxes(x, 1, 2).reshape(b * h, s, d)
+
+
+def _from_bhsd(x, b, h):
+    bh, s, d = x.shape
+    return jnp.swapaxes(x.reshape(b, h, s, d), 1, 2)
+
+
+def _repeat_kv(kv, n_rep):
+    if n_rep == 1:
+        return kv
+    return jnp.repeat(kv, n_rep, axis=2)
+
+
+def supports(q_shape, k_shape, block_q=128) -> bool:
+    """Static check: can the kernel run these shapes (self-attention, divisible seq)."""
+    b, s, h, d = q_shape
+    return (
+        s == k_shape[1] and s % block_q == 0 and s >= block_q
+        and d <= 256 and q_shape[0] == k_shape[0]
+    )
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=128):
+    """Pallas flash attention over paddle layout [B, S, H, D]; GQA via kv-head
+    broadcast. Differentiable (custom VJP flash backward)."""
+    b, s, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    n_rep = h // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    out = _flash(_to_bhsd(q), _to_bhsd(k), _to_bhsd(v), bool(causal), float(scale),
+                 int(block_q))
+    return _from_bhsd(out, b, h)
+
+
+def flashmask_attention(q, k, v, startend_row_indices, causal=True, scale=None,
+                        block_q=128):
+    """FlashMask (reference flash_attention.py:1299): startend_row_indices
+    [B, H'|1, S, n] sparse-mask encoding evaluated inside the kernel — no
+    [B, H, S, S] mask materialisation."""
+    b, s, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    n_rep = h // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    sri = startend_row_indices.astype(jnp.int32)
+    hp = sri.shape[1]
+    if hp == 1 and h > 1:
+        sri = jnp.broadcast_to(sri, (b, h, sri.shape[2], sri.shape[3]))
+    sri = sri.reshape(b * h, sri.shape[2], sri.shape[3])
+    out = _flash_masked(_to_bhsd(q), _to_bhsd(k), _to_bhsd(v), sri, bool(causal),
+                        float(scale), int(block_q))
+    return _from_bhsd(out, b, h)
