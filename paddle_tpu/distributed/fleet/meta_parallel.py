@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ...nn import functional as F
 from ...nn import initializer as I
@@ -245,9 +246,14 @@ class _SharedCaller(Layer):
 
 
 class PipelineParallel(Layer):
-    """Reference pipeline_parallel.py:242 + 1F1B schedule (:684). Host-driven
-    micro-batch loop over stage submodules; on one device the 1F1B order is preserved
-    so loss/convergence semantics match the reference exactly."""
+    """Reference pipeline_parallel.py:242 + 1F1B schedule (:684). Real stage
+    execution: each stage chunk compiles to its own XLA program pinned to a stage
+    device, boundary activations/gradients move with device_put (ICI p2p on TPU),
+    and a host loop drives per-stage 1F1B instruction streams
+    (distributed/fleet/pipeline.py PipelineEngine)."""
+
+    #: chunks per physical stage (overridden by the interleave subclass)
+    _virtual_pp_degree = 1
 
     def __init__(self, layers, hcg, strategy=None):
         super().__init__()
@@ -258,37 +264,71 @@ class PipelineParallel(Layer):
         cfg = (strategy.pipeline_configs if strategy else {}) or {}
         self.accumulate_steps = cfg.get("accumulate_steps", 1)
         self.micro_batch_size = cfg.get("micro_batch_size", 1)
+        if strategy is not None:
+            vpp = (strategy.hybrid_configs or {}).get("pp_configs", {})
+            if isinstance(vpp, dict):
+                self._virtual_pp_degree = vpp.get(
+                    "virtual_pp_degree", self._virtual_pp_degree)
+        self._engine = None
 
     def forward(self, *args, **kwargs):
         return self._layers(*args, **kwargs)
 
+    # ------------------------------------------------------------------ engine
+    def _stage_devices(self, num_stages):
+        devs = jax.devices()
+        if self._hcg is not None and getattr(self._hcg, "mesh", None) is not None:
+            mesh = self._hcg.mesh
+            if "pp" in mesh.dim_names:
+                # first device of each pp coordinate (dp/mp submesh placement of
+                # activations inside a stage comes from the params' shardings)
+                grid = np.moveaxis(
+                    np.asarray(mesh.jax_mesh.devices),
+                    mesh.dim_names.index("pp"), 0,
+                )
+                return [grid[i].reshape(-1)[0] for i in range(grid.shape[0])]
+        return [devs[i % len(devs)] for i in range(num_stages)]
+
+    def _build_engine(self):
+        from .pipeline import PipelineEngine, _Chunk
+
+        p = self._layers.get_num_stages()
+        v = max(1, int(self._virtual_pp_degree))
+        n_chunks = p * v
+        bounds = SegmentLayers(self._layers.layers_desc, n_chunks, "uniform").do_segment()
+        chunks = [
+            _Chunk([self._layers.run_function[i] for i in range(bounds[c], bounds[c + 1])])
+            for c in range(n_chunks)
+        ]
+        stage_devs = self._stage_devices(p)
+        # VPP placement: chunk c lives on stage c % p (reference :1308)
+        devices = [stage_devs[c % p] for c in range(n_chunks)]
+        self._engine = PipelineEngine(chunks, devices, self._layers.loss_fn)
+
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
-        """1F1B semantics on a host loop: forward all chunks' micro-batches with
-        backward interleaved; optimizer.step() after accumulation."""
         from ...ops.manipulation import split
 
+        if self._engine is None:
+            self._build_engine()
         x, y = data
         n_micro = self.accumulate_steps
         micro_x = split(x, n_micro, axis=0) if n_micro > 1 else [x]
         micro_y = split(y, n_micro, axis=0) if n_micro > 1 else [y]
-        total_loss = None
-        for mx, my in zip(micro_x, micro_y):
-            out = self._layers(mx)
-            loss = self._layers.loss_fn(out, my)
-            scaled = loss / float(n_micro)
-            if scaler is not None:
-                scaler.scale(scaled).backward()
-            else:
-                scaled.backward()
-            total_loss = loss.detach() if total_loss is None else total_loss + loss.detach()
-        if scaler is not None:
+        loss_scale = float(scaler._scale) if (
+            scaler is not None and scaler.is_enable()) else 1.0
+        mean_loss, grads = self._engine.run(
+            [m._value for m in micro_x], [m._value for m in micro_y], loss_scale
+        )
+        for t, g in grads.values():
+            t._grad = Tensor(g) if t._grad is None else Tensor(t._grad._value + g)
+        if scaler is not None and scaler.is_enable():
             scaler.step(optimizer)
         else:
             optimizer.step()
         optimizer.clear_grad()
         if lr_scheduler is not None:
             lr_scheduler.step()
-        return total_loss.scale(1.0 / n_micro)
+        return Tensor(mean_loss)
 
     def eval_batch(self, data, compute_loss=True):
         x, y = data
@@ -302,6 +342,19 @@ class PipelineParallel(Layer):
 
     def set_state_dict(self, sd, *a, **k):
         return self._layers.set_state_dict(sd, *a, **k)
+
+
+class PipelineParallelWithInterleave(PipelineParallel):
+    """Interleaved virtual-pipeline (reference pipeline_parallel.py:1308): the
+    layer list splits into num_stages * virtual_pp_degree chunks placed
+    round-robin over stage devices; the chunk chain runs under the same 1F1B
+    engine (per-chunk instruction streams)."""
+
+    def __init__(self, layers, hcg, strategy=None, virtual_pp_degree=2):
+        self._virtual_pp_degree = virtual_pp_degree
+        super().__init__(layers, hcg, strategy)
+        if self._virtual_pp_degree <= 1:
+            self._virtual_pp_degree = virtual_pp_degree
 
 
 class TensorParallel(Layer):

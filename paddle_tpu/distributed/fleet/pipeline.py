@@ -1,0 +1,242 @@
+"""Pipeline parallelism: stage placement + host-driven 1F1B / interleaved schedules.
+
+Reference parity: fleet/meta_parallel/pipeline_parallel.py:684 (1F1B
+forward_backward_pipeline), :1308 (PipelineParallelWithInterleave / VPP),
+pp_utils/p2p_communication.py (p2p transfers).
+
+TPU-native design (SURVEY.md §7.3 item 1): XLA wants one program per launch, so a
+pipeline schedule is a HOST-side loop dispatching per-stage compiled programs.
+Each stage chunk compiles to its own XLA executable pinned to its stage device
+(device_put of boundary activations = the p2p transfer, riding ICI between
+chips); jax's async dispatch overlaps stages automatically — correctness comes
+from dataflow, the 1F1B instruction order controls in-flight activation memory.
+
+Backward recomputes the stage forward inside `jax.vjp` (per-stage remat): only
+boundary activations are ever stored, which is the same activation footprint the
+reference gets from recompute_interval + 1F1B.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from ...autograd import tape
+from ...nn.layer import Layer
+from ...nn.layer_common import LayerList
+from ...tensor import Tensor
+
+
+class _Chunk(Layer):
+    """One pipeline chunk: a consecutive run of the model's layer list."""
+
+    def __init__(self, layers):
+        super().__init__()
+        self.layers = LayerList(layers)
+
+    def forward(self, x):
+        for layer in self.layers:
+            x = layer(x)
+        return x
+
+
+def _is_trainable(t: Tensor) -> bool:
+    return not t.stop_gradient and jnp.issubdtype(t.dtype, jnp.floating)
+
+
+class _StageExec:
+    """Compiled forward / backward / fused-loss-step programs for one chunk,
+    pinned to one device. Mirrors the per-(stage, phase) executable Plan of the
+    reference's static pipeline (new_executor/interpreter/plan.h)."""
+
+    def __init__(self, chunk: _Chunk, device, loss_fn: Callable | None = None):
+        self.chunk = chunk
+        self.device = device
+        self.loss_fn = loss_fn
+        sd = chunk.state_dict()
+        self.param_tensors = dict(sd)
+        self.trainable_keys = [k for k, t in sd.items() if _is_trainable(t)]
+        self.frozen_keys = [k for k in sd if k not in set(self.trainable_keys)]
+        self._fwd = jax.jit(self._fwd_fn)
+        self._bwd = jax.jit(self._bwd_fn)
+        self._last = jax.jit(self._last_fn)
+
+    # -- state handling ------------------------------------------------------
+    def place_params(self, placed: dict):
+        """Pin each owned parameter to this stage's device (first stage to see a
+        shared tensor owns it; later stages get per-batch copies)."""
+        for k, t in self.param_tensors.items():
+            if id(t) not in placed:
+                t._value = jax.device_put(t._value, self.device)
+                placed[id(t)] = self.device
+
+    def states(self):
+        tr = {k: jax.device_put(self.param_tensors[k]._value, self.device)
+              for k in self.trainable_keys}
+        fz = {k: jax.device_put(self.param_tensors[k]._value, self.device)
+              for k in self.frozen_keys}
+        return tr, fz
+
+    # -- traced programs -----------------------------------------------------
+    def _call_chunk(self, tr, fz, x):
+        full = dict(fz)
+        full.update(tr)
+        with tape.no_grad():
+            out = self.chunk.functional_call(full, Tensor(x))
+        return out
+
+    def _fwd_fn(self, tr, fz, x):
+        out = self._call_chunk(tr, fz, x)
+        return out._value if isinstance(out, Tensor) else out
+
+    def _bwd_fn(self, tr, fz, x, gy):
+        def f(tr, x):
+            return self._fwd_fn(tr, fz, x)
+
+        _, vjp = jax.vjp(f, tr, x)
+        dtr, dx = vjp(gy)
+        return dtr, dx
+
+    def _last_fn(self, tr, fz, x, label, loss_scale):
+        def f(tr, x):
+            out = self._call_chunk(tr, fz, x)
+            with tape.no_grad():
+                loss = self.loss_fn(out, Tensor(label))
+            lv = loss._value if isinstance(loss, Tensor) else loss
+            return lv * loss_scale, lv
+
+        grad_fn = jax.value_and_grad(f, argnums=(0, 1), has_aux=True)
+        (_, loss), (dtr, dx) = grad_fn(tr, x)
+        return loss, dtr, dx
+
+    # -- dispatch ------------------------------------------------------------
+    def forward(self, tr, fz, x):
+        return self._fwd(tr, fz, jax.device_put(x, self.device))
+
+    def backward(self, tr, fz, x, gy):
+        return self._bwd(tr, fz, jax.device_put(x, self.device),
+                         jax.device_put(gy, self.device))
+
+    def last_step(self, tr, fz, x, label, loss_scale):
+        return self._last(tr, fz, jax.device_put(x, self.device),
+                          jax.device_put(label, self.device), loss_scale)
+
+
+def _1f1b_instructions(num_stages: int, num_micro: int):
+    """Per-stage 1F1B instruction streams (reference pipeline_parallel.py:684):
+    stage s runs min(p-1-s, m) warmup forwards, alternates 1F/1B, then drains."""
+    streams = []
+    for s in range(num_stages):
+        warmup = min(num_stages - 1 - s, num_micro)
+        ops = [("F", i) for i in range(warmup)]
+        f_i, b_i = warmup, 0
+        while f_i < num_micro:
+            ops.append(("F", f_i))
+            ops.append(("B", b_i))
+            f_i += 1
+            b_i += 1
+        while b_i < num_micro:
+            ops.append(("B", b_i))
+            b_i += 1
+        streams.append(ops)
+    return streams
+
+
+class PipelineEngine:
+    """Executes a chunk chain over stage devices with per-stage 1F1B streams.
+
+    chunks[i] feeds chunks[i+1]; chunk i is placed on devices[i]. For plain PP
+    the chain length equals the stage count; for interleaved VPP the chain is
+    num_stages * virtual_pp_degree chunks placed round-robin (chunk c on device
+    c % num_stages), reproducing the reference's VPP placement
+    (pipeline_parallel.py:1308)."""
+
+    def __init__(self, chunks, devices, loss_fn):
+        self.execs = [
+            _StageExec(c, devices[i], loss_fn if i == len(chunks) - 1 else None)
+            for i, c in enumerate(chunks)
+        ]
+        placed: dict = {}
+        for ex in self.execs:
+            ex.place_params(placed)
+        self._placed = placed
+
+    def run(self, micro_inputs, micro_labels, loss_scale=1.0):
+        """One accumulation window. Returns (mean_loss, {id(param): grad})."""
+        n_chunks = len(self.execs)
+        m = len(micro_inputs)
+        streams = _1f1b_instructions(n_chunks, m)
+        cursors = [0] * n_chunks
+        states = [ex.states() for ex in self.execs]
+        acts_in: list[dict] = [dict() for _ in range(n_chunks)]   # stage -> mb -> x
+        grads_in: list[dict] = [dict() for _ in range(n_chunks)]  # stage -> mb -> gy
+        for i, x in enumerate(micro_inputs):
+            acts_in[0][i] = x
+        acc_grads: list[dict | None] = [None] * n_chunks
+        losses = []
+        inv_m = 1.0 / m
+
+        def ready(s, op, mb):
+            if op == "F":
+                return mb in acts_in[s]
+            if s == n_chunks - 1:
+                return mb in acts_in[s]
+            return mb in grads_in[s] and mb in acts_in[s]
+
+        def execute(s, op, mb):
+            ex = self.execs[s]
+            tr, fz = states[s]
+            if op == "F":
+                if s == n_chunks - 1:
+                    return  # fused into B (loss fwd+bwd in one program)
+                y = ex.forward(tr, fz, acts_in[s][mb])
+                # p2p send: move the boundary activation to the next stage's
+                # device now (ICI transfer overlaps with ongoing compute)
+                acts_in[s + 1][mb] = jax.device_put(y, self.execs[s + 1].device)
+                return
+            x = acts_in[s][mb]
+            if s == n_chunks - 1:
+                loss, dtr, dx = ex.last_step(tr, fz, x, micro_labels[mb],
+                                             loss_scale * inv_m)
+                losses.append(loss)
+            else:
+                dtr, dx = ex.backward(tr, fz, x, grads_in[s][mb])
+            del acts_in[s][mb]
+            if s > 0:
+                grads_in[s - 1][mb] = jax.device_put(dx, self.execs[s - 1].device)
+            acc_grads[s] = dtr if acc_grads[s] is None else jax.tree_util.tree_map(
+                jnp.add, acc_grads[s], dtr
+            )
+
+        remaining = sum(len(st) for st in streams)
+        while remaining:
+            progressed = False
+            for s in range(n_chunks - 1, -1, -1):
+                while cursors[s] < len(streams[s]):
+                    op, mb = streams[s][cursors[s]]
+                    if not ready(s, op, mb):
+                        break
+                    execute(s, op, mb)
+                    cursors[s] += 1
+                    remaining -= 1
+                    progressed = True
+            if not progressed:
+                raise RuntimeError("pipeline schedule deadlocked (bug)")
+
+        # map accumulated grads back to live parameter tensors (shared layers:
+        # grads from multiple chunks sum onto the owner's device)
+        grads_by_param: dict = {}
+        for s, ex in enumerate(self.execs):
+            if acc_grads[s] is None:
+                continue
+            for k, g in acc_grads[s].items():
+                t = ex.param_tensors[k]
+                dev = self._placed[id(t)]
+                g = jax.device_put(g, dev)
+                if id(t) in grads_by_param:
+                    grads_by_param[id(t)] = (t, grads_by_param[id(t)][1] + g)
+                else:
+                    grads_by_param[id(t)] = (t, g)
+        mean_loss = sum(jax.device_put(l, self.execs[-1].device) for l in losses) / m
+        return mean_loss, grads_by_param

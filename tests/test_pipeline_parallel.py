@@ -1,0 +1,158 @@
+"""Pipeline parallelism tests on the virtual 8-device CPU mesh (SURVEY.md §4).
+
+Contract (VERDICT r1 item 2): a pp=2/pp=4 pipeline must reproduce the
+single-process micro-batch-accumulation loss over >=10 training steps, with
+stage parameters actually placed on distinct devices and train_batch running
+the 1F1B engine, not a sequential loop."""
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed.fleet.meta_parallel import (
+    LayerDesc, PipelineLayer, PipelineParallel, PipelineParallelWithInterleave,
+)
+from paddle_tpu.distributed.fleet.pipeline import _1f1b_instructions
+
+HID = 16
+N_LAYERS = 8
+MICRO = 4
+BATCH = 8
+
+
+def _make_descs():
+    descs = [LayerDesc(nn.Linear, HID, HID) for _ in range(N_LAYERS)]
+    return descs
+
+
+def _loss_fn(out, label):
+    return ((out - label) ** 2).mean()
+
+
+def _data(step):
+    rs = np.random.RandomState(step)
+    x = paddle.to_tensor(rs.randn(BATCH, HID).astype("float32"))
+    y = paddle.to_tensor(rs.randn(BATCH, HID).astype("float32"))
+    return x, y
+
+
+def _run_reference(steps=10):
+    """Single-process micro-batch grad accumulation — same math, no pipeline."""
+    paddle.seed(42)
+    model = PipelineLayer(_make_descs(), num_stages=1, loss_fn=_loss_fn)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    losses = []
+    for step in range(steps):
+        x, y = _data(step)
+        xs = paddle.split(x, MICRO, axis=0)
+        ys = paddle.split(y, MICRO, axis=0)
+        total = 0.0
+        for mx, my in zip(xs, ys):
+            loss = _loss_fn(model(mx), my)
+            (loss / MICRO).backward()
+            total += float(loss)
+        opt.step()
+        opt.clear_grad()
+        losses.append(total / MICRO)
+    return losses, model
+
+
+def _run_pipeline(num_stages, steps=10, interleave=False, vpp=2):
+    paddle.seed(42)
+    model = PipelineLayer(_make_descs(), num_stages=num_stages, loss_fn=_loss_fn)
+
+    class _Cfg:
+        pipeline_configs = {"accumulate_steps": MICRO, "micro_batch_size": BATCH // MICRO}
+        hybrid_configs = {}
+
+    cls = PipelineParallelWithInterleave if interleave else PipelineParallel
+    kwargs = {"virtual_pp_degree": vpp} if interleave else {}
+    pp = cls(model, hcg=None, strategy=_Cfg(), **kwargs)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    losses = []
+    for step in range(steps):
+        loss = pp.train_batch(_data(step), opt)
+        losses.append(float(loss))
+    return losses, model, pp
+
+
+def test_1f1b_instruction_streams():
+    """Schedule shape: stage s does p-1-s warmup forwards then strict 1F1B."""
+    streams = _1f1b_instructions(4, 8)
+    assert [op for op, _ in streams[0][:3]] == ["F", "F", "F"]
+    assert [op for op, _ in streams[3][:2]] == ["F", "B"]  # last stage: no warmup
+    for s, ops in enumerate(streams):
+        assert len(ops) == 16
+        assert [mb for op, mb in ops if op == "F"] == list(range(8))
+        assert [mb for op, mb in ops if op == "B"] == list(range(8))
+        # 1F1B property: at most p-s forwards are ever un-backwarded
+        depth = 0
+        for op, _ in ops:
+            depth += 1 if op == "F" else -1
+            assert depth <= 4 - s
+
+
+@pytest.mark.parametrize("num_stages", [2, 4])
+def test_pipeline_matches_single_device(num_stages):
+    ref_losses, ref_model = _run_reference()
+    pp_losses, pp_model, _ = _run_pipeline(num_stages)
+    np.testing.assert_allclose(pp_losses, ref_losses, rtol=1e-6, atol=1e-7)
+    for (kr, tr), (kp, tp) in zip(
+        sorted(ref_model.state_dict().items()), sorted(pp_model.state_dict().items())
+    ):
+        np.testing.assert_allclose(
+            np.asarray(tr._value), np.asarray(tp._value), rtol=1e-6, atol=1e-7,
+        )
+
+
+def test_pipeline_stage_placement():
+    """Stage params must live on distinct devices (real placement, not a loop)."""
+    _, model, pp = _run_pipeline(4, steps=1)
+    devs = set()
+    for ex in pp._engine.execs:
+        stage_devs = {next(iter(t._value.devices())) for t in ex.param_tensors.values()}
+        assert len(stage_devs) == 1  # whole stage on one device
+        devs |= stage_devs
+    assert len(devs) == 4  # four stages, four devices
+
+
+def test_interleaved_vpp_matches_single_device():
+    ref_losses, _ = _run_reference()
+    vpp_losses, _, pp = _run_pipeline(2, interleave=True, vpp=2)
+    np.testing.assert_allclose(vpp_losses, ref_losses, rtol=1e-6, atol=1e-7)
+    # chunk placement is round-robin over stage devices
+    devs = [next(iter(next(iter(ex.param_tensors.values()))._value.devices()))
+            for ex in pp._engine.execs]
+    assert len(pp._engine.execs) == 4  # 2 stages x vpp 2
+    assert devs[0] == devs[2] and devs[1] == devs[3] and devs[0] != devs[1]
+
+
+def test_pipeline_shared_layers():
+    """SharedLayerDesc (tied weights) across stages: grads from both uses sum."""
+    from paddle_tpu.distributed.fleet.meta_parallel import SharedLayerDesc
+
+    def _build(num_stages):
+        paddle.seed(7)
+        descs = [
+            SharedLayerDesc("tied", nn.Linear, None, "weight", HID, HID),
+            LayerDesc(nn.Linear, HID, HID),
+            LayerDesc(nn.Linear, HID, HID),
+            SharedLayerDesc("tied", nn.Linear, None, "weight", HID, HID),
+        ]
+        model = PipelineLayer(descs, num_stages=num_stages, loss_fn=_loss_fn)
+
+        class _Cfg:
+            pipeline_configs = {"accumulate_steps": MICRO, "micro_batch_size": 2}
+            hybrid_configs = {}
+
+        pp = PipelineParallel(model, hcg=None, strategy=_Cfg())
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+        return model, pp, opt
+
+    m1, pp1, opt1 = _build(1)
+    m2, pp2, opt2 = _build(2)
+    for step in range(3):
+        l1 = pp1.train_batch(_data(step), opt1)
+        l2 = pp2.train_batch(_data(step), opt2)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
