@@ -5,7 +5,7 @@ from .env import (  # noqa: F401
     ParallelEnv, get_rank, get_world_size, init_parallel_env, is_initialized,
 )
 from .mesh import (  # noqa: F401
-    Partial, Placement, ProcessMesh, Replicate, Shard, get_mesh, set_mesh,
+    Partial, Placement, ProcessMesh, Replicate, Shard, auto_mesh, get_mesh, set_mesh,
 )
 from .api import (  # noqa: F401
     ShardingStage1, ShardingStage2, ShardingStage3, dtensor_from_local, reshard,

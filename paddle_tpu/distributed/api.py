@@ -108,14 +108,24 @@ def unshard_dtensor(dist_tensor):
 
 
 class _ShardOptimizer:
-    """Wraps an optimizer so accumulator state inherits each parameter's sharding, and
-    (for ShardingStage1/2/3 configs) shards states/grads/params along the data axis —
-    ZeRO as layout, not buffer bookkeeping (reference: api.py:1735 shard_optimizer,
-    ShardingStage*)."""
+    """Wraps an optimizer with a ZeRO stage recipe. The recipe's layouts are
+    enforced both on the eager path (step() re-places state) and — the real
+    perf path — inside TrainStep's single compiled program, where the stage
+    becomes in/out shardings + gradient sharding constraints and XLA emits the
+    reduce-scatter / all-gather pattern (reference:
+    dygraph_sharding_optimizer.py:54, group_sharded_stage3.py:85)."""
 
     def __init__(self, optimizer, shard_fn=None):
         self._inner = optimizer
         self._shard_fn = shard_fn
+        if shard_fn is not None and hasattr(shard_fn, "place_params"):
+            shard_fn.place_params(optimizer)
+
+    @property
+    def _inner_opt(self):
+        # TrainStep unwraps via this; accumulator mutation must hit the inner
+        # optimizer object, not this facade
+        return getattr(self._inner, "_inner_opt", self._inner)
 
     def __getattr__(self, name):
         return getattr(self._inner, name)
@@ -127,55 +137,87 @@ class _ShardOptimizer:
             for acc_name, store in self._inner._accumulators.items():
                 for _, p in self._inner._parameters_list():
                     if id(p) in store:
-                        store[id(p)] = sf._place_state(p, store[id(p)])
+                        store[id(p)] = sf.place_state(p, store[id(p)])
 
 
 class ShardingStage1:
-    """Optimizer-state sharding along a mesh axis (ZeRO-1 ≈ state layout on 'dp')."""
+    """ZeRO-1: optimizer state sharded along the dp/sharding mesh axis.
+    Params + grads stay replicated."""
+
+    shard_params = False
+    shard_grads = False
 
     def __init__(self, axis_name="dp", mesh=None):
         self.axis_name = axis_name
         self.mesh = mesh
 
-    def _place_state(self, p, state_val):
+    # -- layout queries (used by TrainStep) ---------------------------------
+    def _mesh(self):
         from .mesh import get_mesh
 
-        mesh = self.mesh or get_mesh()
-        if mesh is None or state_val.ndim == 0:
-            return state_val
-        # shard dim 0 of the state along the dp axis when divisible
-        dp = mesh.get_dim_size(self.axis_name) if self.axis_name in mesh.dim_names else 1
-        if dp > 1 and state_val.shape and state_val.shape[0] % dp == 0:
-            from jax.sharding import NamedSharding, PartitionSpec
+        return self.mesh or get_mesh()
 
-            sh = NamedSharding(mesh.jax_mesh,
-                               PartitionSpec(self.axis_name, *([None] * (state_val.ndim - 1))))
-            return jax.device_put(state_val, sh)
-        return state_val
+    def _spec(self, shape):
+        """dim-0 sharding spec along the stage axis, or None if not shardable."""
+        mesh = self._mesh()
+        if mesh is None or self.axis_name not in mesh.dim_names:
+            return None
+        n = mesh.get_dim_size(self.axis_name)
+        if n <= 1 or not shape or shape[0] % n != 0:
+            return None
+        from jax.sharding import PartitionSpec
+
+        return PartitionSpec(self.axis_name, *([None] * (len(shape) - 1)))
+
+    def sharding_of(self, shape):
+        spec = self._spec(shape)
+        if spec is None:
+            return None
+        from jax.sharding import NamedSharding
+
+        return NamedSharding(self._mesh().jax_mesh, spec)
+
+    def acc_sharding(self, param, shape):
+        return self.sharding_of(shape)
+
+    def param_sharding(self, param):
+        return self.sharding_of(tuple(param.shape)) if self.shard_params else None
+
+    def grad_sharding(self, shape):
+        return self.sharding_of(shape) if self.shard_grads else None
+
+    # -- eager path ---------------------------------------------------------
+    def place_state(self, p, state_val):
+        sh = self.acc_sharding(p, tuple(getattr(state_val, "shape", ())))
+        return jax.device_put(state_val, sh) if sh is not None else state_val
+
+    def place_params(self, optimizer):
+        if not self.shard_params:
+            return
+        for _, p in optimizer._parameters_list():
+            sh = self.sharding_of(tuple(p.shape))
+            if sh is not None:
+                p._value = jax.device_put(p._value, sh)
+                p._dist_attr = (self._mesh(), None)
+
+    # kept for round-1 API compatibility
+    _place_state = place_state
 
 
 class ShardingStage2(ShardingStage1):
-    pass
+    """ZeRO-2: + gradients reduce-scattered (sharded) along the stage axis.
+    Inside the compiled TrainStep the gradient values carry a dim-0 sharding
+    constraint, which turns the dp gradient all-reduce into reduce-scatter."""
+
+    shard_grads = True
 
 
 class ShardingStage3(ShardingStage1):
-    def _place_state(self, p, state_val):
-        # stage 3 also shards the parameter itself
-        out = super()._place_state(p, state_val)
-        from .mesh import get_mesh
+    """ZeRO-3: + parameters sharded; GSPMD all-gathers each weight at its use
+    site (gather-on-use) instead of keeping a full replica resident."""
 
-        mesh = self.mesh or get_mesh()
-        if mesh is not None and p._value.ndim and p._value.shape[0] % max(
-            mesh.get_dim_size(self.axis_name) if self.axis_name in mesh.dim_names else 1, 1
-        ) == 0:
-            from jax.sharding import NamedSharding, PartitionSpec
-
-            dp = mesh.get_dim_size(self.axis_name)
-            if dp > 1:
-                sh = NamedSharding(mesh.jax_mesh,
-                                   PartitionSpec(self.axis_name, *([None] * (p._value.ndim - 1))))
-                p._value = jax.device_put(p._value, sh)
-        return out
+    shard_grads = True
+    shard_params = True
 
 
 def shard_optimizer(optimizer, shard_fn=None):

@@ -69,6 +69,18 @@ class TrainStep:
         self._jitted = None
         self._compiled = None  # AOT executable installed by aot_prime()
         self._seed = 0
+        # ZeRO stage recipe (dist.shard_optimizer(opt, ShardingStage1/2/3)):
+        # enforced as shardings inside the compiled step — state in, grads mid,
+        # state out — so the layout lives in ONE XLA program (reduce-scatter /
+        # gather-on-use emitted by GSPMD), no eager relayout round-trips.
+        self._stage = getattr(optimizer, "_shard_fn", None)
+        if self._stage is not None and not hasattr(self._stage, "acc_sharding"):
+            self._stage = None
+        if self._stage is not None:
+            for k, t in self._param_tensors.items():
+                sh = self._stage.param_sharding(t)
+                if sh is not None:
+                    t._value = jax.device_put(t._value, sh)
 
     # -------------------------------------------------------------- traced step
     def _build(self):
@@ -79,6 +91,7 @@ class TrainStep:
         param_tensors = self._param_tensors
         # map param name -> live Parameter object (ids stable across calls)
         inner_opt = getattr(opt, "_inner_opt", opt)
+        stage = self._stage
 
         import inspect
 
@@ -126,6 +139,15 @@ class TrainStep:
             (loss_val, new_buffers), grads = jax.value_and_grad(
                 loss_from, has_aux=True
             )(trainable_state)
+            if stage is not None and stage.shard_grads:
+                # ZeRO-2/3: constrain gradient layout to the stage axis so the
+                # dp gradient all-reduce lowers to reduce-scatter
+                grads = {
+                    k: (jax.lax.with_sharding_constraint(g, sh)
+                        if (sh := stage.grad_sharding(tuple(g.shape))) is not None
+                        else g)
+                    for k, g in grads.items()
+                }
             grads = _functional_clip(inner_opt._grad_clip, grads,
                                      trainable_state)
             # run optimizer update rules traced: swap accumulator store
@@ -164,6 +186,28 @@ class TrainStep:
                 inner_opt._accumulators = saved_acc
                 inner_opt._step_count = saved_step
             new_state.update(new_buffers)
+            if stage is not None:
+                # pin output layouts: params (stage 3: sharded; stages 1-2:
+                # replicated, or XLA would propagate the acc sharding onto them)
+                # and optimizer state (stages 1-3: sharded)
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                stage_mesh = stage._mesh()
+                for k in trainable_keys:
+                    psh = stage.param_sharding(param_tensors[k])
+                    if psh is None and stage_mesh is not None and getattr(
+                            param_tensors[k], "_dist_attr", None) is None:
+                        psh = NamedSharding(stage_mesh.jax_mesh, PartitionSpec())
+                    if psh is not None:
+                        new_state[k] = jax.lax.with_sharding_constraint(
+                            new_state[k], psh)
+                for acc_name, per in new_acc.items():
+                    for k, v in per.items():
+                        if v is None:
+                            continue
+                        ash = stage.acc_sharding(param_tensors[k], tuple(v.shape))
+                        if ash is not None:
+                            per[k] = jax.lax.with_sharding_constraint(v, ash)
             return loss_val, new_state, new_acc
 
         return jax.jit(step_fn, donate_argnums=(0, 1))
@@ -186,6 +230,13 @@ class TrainStep:
                 acc[acc_name] = {
                     k: jnp.zeros_like(t._value) for k, t in self._trainable.items()
                 }
+            if self._stage is not None:
+                for acc_name, per in acc.items():
+                    for k, v in per.items():
+                        sh = self._stage.acc_sharding(self._param_tensors[k],
+                                                      tuple(v.shape))
+                        if sh is not None:
+                            per[k] = jax.device_put(v, sh)
         return acc
 
     def _prep_inputs(self, advance: bool):

@@ -1,0 +1,134 @@
+"""ZeRO sharding stage tests (VERDICT r1 item 5): stages live INSIDE the
+compiled TrainStep as layouts; numerics match the unsharded baseline and the
+per-device shard sizes actually shrink per stage."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import nn
+from paddle_tpu.jit.train import TrainStep
+
+DP = 8
+DIM = 16  # divisible by 8 so dim-0 sharding applies
+
+
+def _model():
+    paddle.seed(0)
+    return nn.Sequential(
+        nn.Linear(DIM, 4 * DIM), nn.GELU(), nn.Linear(4 * DIM, DIM),
+    )
+
+
+def _data():
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(DP * 2, DIM).astype("float32"))
+    y = paddle.to_tensor(rs.randn(DP * 2, DIM).astype("float32"))
+    return x, y
+
+
+def _run(stage, steps=5, shard_batch=True):
+    mesh = dist.auto_mesh(DP, dim_names=["dp"])
+    prev = dist.get_mesh()
+    dist.set_mesh(mesh)
+    try:
+        model = _model()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=model.parameters())
+        if stage is not None:
+            opt = dist.shard_optimizer(opt, stage(("dp"), mesh))
+        loss_fn = nn.MSELoss()
+        step = TrainStep(model, lambda o, y: loss_fn(o, y), opt)
+        x, y = _data()
+        if shard_batch:
+            bsh = NamedSharding(mesh.jax_mesh, PartitionSpec("dp"))
+            x = paddle.Tensor(jax.device_put(x._value, bsh))
+            y = paddle.Tensor(jax.device_put(y._value, bsh))
+        losses = [float(step(x, y)) for _ in range(steps)]
+        return losses, model, opt, step
+    finally:
+        dist.set_mesh(prev)
+
+
+def _shard_frac(arr):
+    """fraction of the global array held by one device"""
+    sh = arr.addressable_shards[0]
+    return sh.data.size / arr.size
+
+
+@pytest.mark.parametrize("stage_cls", [dist.ShardingStage1, dist.ShardingStage2,
+                                       dist.ShardingStage3])
+def test_stage_numerics_match_baseline(stage_cls):
+    base, base_model, _, _ = _run(None)
+    got, model, _, _ = _run(stage_cls)
+    np.testing.assert_allclose(got, base, rtol=1e-5, atol=1e-6)
+    for (kb, tb), (km, tm) in zip(sorted(base_model.state_dict().items()),
+                                  sorted(model.state_dict().items())):
+        np.testing.assert_allclose(np.asarray(tb._value), np.asarray(tm._value),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_stage1_shards_opt_state_only():
+    _, model, opt, _ = _run(dist.ShardingStage1)
+    inner = opt._inner_opt
+    # optimizer moments: dim-0 sharded 1/8 per device
+    fracs = [
+        _shard_frac(v) for store in inner._accumulators.values()
+        for v in store.values() if v.ndim >= 1 and v.shape[0] % DP == 0
+    ]
+    assert fracs and all(abs(f - 1 / DP) < 1e-9 for f in fracs)
+    # params stay replicated
+    for p in model.parameters():
+        assert _shard_frac(p._value) == 1.0
+
+
+def test_stage3_shards_params():
+    _, model, opt, step = _run(dist.ShardingStage3)
+    sharded = [p for p in model.parameters() if p._value.shape
+               and p._value.shape[0] % DP == 0]
+    assert sharded
+    for p in sharded:
+        assert abs(_shard_frac(p._value) - 1 / DP) < 1e-9
+
+
+def test_stage2_constrains_gradients():
+    """Stage-2 adds per-gradient sharding constraints inside the traced step
+    (the reduce-scatter semantics; XLA's CPU SPMD backend lowers them via
+    all-to-all, TPU emits reduce-scatter). Observable: the stage-2 program
+    carries strictly more sharding annotations than stage-1."""
+    mesh = dist.auto_mesh(DP, dim_names=["dp"])
+    prev = dist.get_mesh()
+    dist.set_mesh(mesh)
+    try:
+        def n_sharding_ops(stage_cls):
+            model = _model()
+            opt = dist.shard_optimizer(
+                paddle.optimizer.AdamW(learning_rate=1e-2,
+                                       parameters=model.parameters()),
+                stage_cls("dp", mesh))
+            loss_fn = nn.MSELoss()
+            step = TrainStep(model, lambda o, y: loss_fn(o, y), opt)
+            x, y = _data()
+            bsh = NamedSharding(mesh.jax_mesh, PartitionSpec("dp"))
+            x = paddle.Tensor(jax.device_put(x._value, bsh))
+            y = paddle.Tensor(jax.device_put(y._value, bsh))
+            stablehlo = step.lowered(x, y).as_text()
+            # shardy spells it sdy.sharding_constraint; legacy GSPMD uses the
+            # Sharding custom-call
+            return (stablehlo.count("sdy.sharding_constraint")
+                    or stablehlo.count("Sharding"))
+
+        assert n_sharding_ops(dist.ShardingStage2) > n_sharding_ops(dist.ShardingStage1)
+    finally:
+        dist.set_mesh(prev)
+
+
+def test_stage2_differs_from_stage1():
+    """Regression for round-1 'class ShardingStage2(ShardingStage1): pass'."""
+    assert dist.ShardingStage1.shard_grads is False
+    assert dist.ShardingStage2.shard_grads is True
+    assert dist.ShardingStage2.shard_params is False
+    assert dist.ShardingStage3.shard_params is True
