@@ -36,6 +36,39 @@ def _is_diffable(a) -> bool:
     )
 
 
+def _amp_wrap(fn, name: str):
+    """AMP O1/O2 hook (reference: eager_gen.py emits an AMP branch into every
+    ad_func; here ONE dispatch-time wrapper consults the lists). Casting happens
+    inside the differentiated fn so astype's VJP casts gradients back to each
+    input's original dtype."""
+    from .. import amp as _amp
+
+    if not _amp.is_auto_cast_enabled():
+        return fn
+    level = _amp.get_amp_level()
+    target = None
+    if name in _amp.black_list():
+        target = jnp.float32
+    elif level == "O1":
+        if name in _amp.white_list():
+            target = _amp.get_amp_dtype()
+    else:  # O2: everything low-precision except the black list
+        target = _amp.get_amp_dtype()
+    if target is None:
+        return fn
+
+    def amp_fn(*vals, **kwargs):
+        cast = [
+            v.astype(target)
+            if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating)
+            and v.dtype != target else v
+            for v in vals
+        ]
+        return fn(*cast, **kwargs)
+
+    return amp_fn
+
+
 def apply_op(fn, name: str, *args, nout: int | None = None, **kwargs):
     """Run `fn(*vals, **kwargs)`; record a tape node if autograd applies.
 
@@ -43,6 +76,7 @@ def apply_op(fn, name: str, *args, nout: int | None = None, **kwargs):
     (never differentiated). Returns Tensor or tuple of Tensors (list outputs of fn are
     returned as lists of Tensors, mirroring ops like `split`).
     """
+    fn = _amp_wrap(fn, name)
     vals = [_unwrap(a) for a in args]
     need_grad = tape.is_grad_enabled() and _builtins.any(_is_diffable(a) for a in args)
 
