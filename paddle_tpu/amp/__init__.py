@@ -133,7 +133,9 @@ class GradScaler:
                 g = p._grad * inv
                 p._grad = g
                 found = found or bool(jnp.any(~jnp.isfinite(g)))
-        self._found_inf = found
+        # OR, don't overwrite: with two optimizers sharing one scaler a clean
+        # second unscale_ must not erase an inf found on the first
+        self._found_inf = self._found_inf or found
 
     def step(self, optimizer):
         if not self._enable:
@@ -149,7 +151,13 @@ class GradScaler:
         self.step(optimizer)
 
     def update(self):
-        if not (self._enable and self._dynamic):
+        if not self._enable:
+            return
+        if not self._dynamic:
+            # static scale: still end the step — clear per-step bookkeeping so
+            # the next unscale_ isn't a no-op carrying a stale found_inf
+            self._found_inf = False
+            self._unscaled_opts.clear()
             return
         if self._found_inf:
             self._bad_steps += 1

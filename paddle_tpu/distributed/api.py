@@ -18,6 +18,7 @@ from .mesh import (
     Replicate,
     Shard,
     sharding_for,
+    spec_for,
 )
 
 
@@ -56,17 +57,17 @@ def reshard(dist_tensor, mesh: ProcessMesh, placements):
     (src, dst) shardings. Partial→Replicate/Shard emits the pending reduction."""
     t = dist_tensor
     src_attr = t._dist_attr
-    if src_attr is not None:
-        src_placements = src_attr[1]
-        has_partial = any(isinstance(p, Partial) for p in src_placements)
-    else:
-        has_partial = False
     val = t._value
-    if has_partial:
-        # pending-sum state is tracked logically; the payload already holds partial sums
-        # replicated per rank only under shard_map paths. At the global-array level XLA
-        # keeps values consistent, so this reduces to a relayout.
-        pass
+    partial_resolved = any(isinstance(p, Partial) for p in placements)
+    if src_attr is not None and not partial_resolved:
+        src_mesh, src_placements = src_attr[0], src_attr[1]
+        partial_axes = [
+            src_mesh.dim_names[i]
+            for i, p in enumerate(src_placements)
+            if isinstance(p, Partial) and i < len(src_mesh.dim_names)
+        ]
+        if partial_axes:
+            val = _resolve_partial(val, src_mesh, src_placements, partial_axes)
     sharding = sharding_for(mesh, placements, t.ndim)
     if isinstance(val, jax.core.Tracer):
         new_val = jax.lax.with_sharding_constraint(val, sharding)
@@ -77,6 +78,60 @@ def reshard(dist_tensor, mesh: ProcessMesh, placements):
     out._grad_node = t._grad_node
     out._grad_index = t._grad_index
     return out
+
+
+_PARTIAL_REDUCERS: dict = {}
+_PARTIAL_REDUCE_FNS = {
+    "sum": jax.lax.psum,
+    "avg": jax.lax.pmean,
+    "max": jax.lax.pmax,
+    "min": jax.lax.pmin,
+}
+
+
+def _resolve_partial(val, src_mesh, src_placements, partial_axes):
+    """Partial→Replicate: explicit reduction over the pending mesh axes, honoring
+    each Partial's reduce_type (mirrors the reference's p_to_r reshard function,
+    reshard/p_to_r_reshard_function.cc).
+
+    Eager: per-device buffers along a Partial axis hold partial values, so run a
+    shard_map over the source mesh and reduce them. Traced (inside jit under
+    GSPMD): partial state is an XLA-internal concept — the traced value is
+    already the full reduction, so this is the identity there. The jitted
+    reducer is cached per (mesh, placements, shape) so repeated eager resharding
+    doesn't recompile.
+    """
+    if isinstance(val, jax.core.Tracer):
+        return val
+
+    ndim = getattr(val, "ndim", 0)
+    # (axis_name, reduce_type) pairs, in mesh-dim order
+    axis_ops = tuple(
+        (src_mesh.dim_names[i], getattr(p, "reduce_type", "sum"))
+        for i, p in enumerate(src_placements)
+        if isinstance(p, Partial) and src_mesh.dim_names[i] in partial_axes
+    )
+    key = (src_mesh, tuple(src_placements), axis_ops, ndim)
+    reducer = _PARTIAL_REDUCERS.get(key)
+    if reducer is None:
+        from jax import shard_map as _smap
+
+        in_spec = spec_for(src_mesh, src_placements, ndim)
+
+        def _reduce(v):
+            for ax, op in axis_ops:
+                fn = _PARTIAL_REDUCE_FNS.get(op)
+                if fn is None:
+                    raise NotImplementedError(
+                        f"Partial reduce_type {op!r} not supported")
+                v = fn(v, ax)
+            return v
+
+        reducer = jax.jit(
+            _smap(_reduce, mesh=src_mesh.jax_mesh, in_specs=in_spec,
+                  out_specs=in_spec, check_vma=False))
+        _PARTIAL_REDUCERS[key] = reducer
+    return reducer(val)
 
 
 def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None, output_fn=None):

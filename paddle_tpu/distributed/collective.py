@@ -92,8 +92,12 @@ class Group:
         on every rank of the group'."""
         from jax import shard_map as _smap
 
+        # check_vma=False: collective results (all_gather/psum) are replicated
+        # across the axis but jax's varying-manual-axes check cannot infer that
+        # for replicated out_specs like P(None); the collectives themselves
+        # guarantee it.
         return jax.jit(_smap(fn, mesh=self.jax_mesh, in_specs=in_specs,
-                             out_specs=out_specs))
+                             out_specs=out_specs, check_vma=False))
 
 
 _default_group: Group | None = None
@@ -399,22 +403,56 @@ class P2POp:
 
 
 def batch_isend_irecv(p2p_op_list):
-    """In-trace with a uniform ring pattern (every rank sends to rank+k): one
-    ppermute. Otherwise falls back to the eager host-buffer path per op."""
+    """In-trace: group (send, recv) ops into pairs by matching peer offset and
+    issue one ppermute per uniform pair — a bidirectional boundary exchange
+    (send +1 / recv -1 alongside send -1 / recv +1) becomes two ppermutes with
+    each recv getting its own payload. Falls back to the eager host-buffer path
+    when offsets can't be matched or we're outside a trace."""
     sends = [op for op in p2p_op_list if op.op is isend]
     recvs = [op for op in p2p_op_list if op.op is irecv]
-    if sends and recvs and all(_in_trace(op.tensor._value) for op in p2p_op_list):
+    in_trace = any(_in_trace(op.tensor._value) for op in p2p_op_list)
+    if sends and recvs and in_trace:
         g = _get_group(sends[0].group)
         ax = g.axis_name
         if ax is not None and _axis_in_scope(ax):
             n = g.nranks
-            # uniform shift: peer offsets agree across the op list
-            off = (sends[0].peer - g.rank) % n if not _in_trace(sends[0].peer) else 1
-            perm = [(i, (i + off) % n) for i in range(n)]
-            out = jax.lax.ppermute(sends[0].tensor._value, ax, perm)
-            for r in recvs:
-                r.tensor._value = out
+            me = g.rank if g.rank >= 0 else 0
+            pairs = None
+            if not any(_in_trace(op.peer) for op in p2p_op_list):
+                # offset of a send = where my payload goes; a recv with offset
+                # -k pairs with a send of offset +k issued by every rank.
+                send_by_off = {}
+                for s_op in sends:
+                    send_by_off.setdefault((s_op.peer - me) % n, []).append(s_op)
+                pairs, used = [], {}
+                for r_op in recvs:
+                    off = (me - r_op.peer) % n  # sender's forward offset
+                    cands = send_by_off.get(off, [])
+                    i = used.get(off, 0)
+                    if i >= len(cands):
+                        pairs = None
+                        break
+                    pairs.append((cands[i], r_op, off))
+                    used[off] = i + 1
+                if pairs is not None and len(sends) != len(recvs):
+                    pairs = None
+            if pairs is None:
+                # traced peers or unmatchable offsets: assume the uniform
+                # next-rank ring (the PP p2p pattern); positional send/recv
+                # pairing. Eager host buffers can't hold tracers, so this is
+                # the only in-trace degradation available.
+                off = 1
+                pairs = [(s, r, off) for s, r in zip(sends, recvs)]
+            for s_op, r_op, off in pairs:
+                perm = [(i, (i + off) % n) for i in range(n)]
+                r_op.tensor._value = jax.lax.ppermute(
+                    s_op.tensor._value, ax, perm)
             return [_Work() for _ in p2p_op_list]
+    if in_trace:
+        raise RuntimeError(
+            "batch_isend_irecv inside a trace requires the group's mesh axis in "
+            "scope (shard_map over the group); eager host-buffer p2p cannot "
+            "transport traced values")
     return [op.op(op.tensor, op.peer, op.group) for op in p2p_op_list]
 
 

@@ -68,6 +68,7 @@ class TrainStep:
         }
         self._jitted = None
         self._compiled = None  # AOT executable installed by aot_prime()
+        self._compiled_avals = None  # arg shapes/dtypes the AOT exe was built for
         self._seed = 0
         # ZeRO stage recipe (dist.shard_optimizer(opt, ShardingStage1/2/3)):
         # enforced as shardings inside the compiled step — state in, grads mid,
@@ -271,11 +272,26 @@ class TrainStep:
         __call__s reuse it (avoids the separate jit-cache compile). Returns the
         jax compiled object (cost_analysis(), as_text())."""
         self._compiled = self.lowered(*args, **kwargs).compile()
+        self._compiled_avals = self._arg_avals(args, kwargs)
         return self._compiled
+
+    @staticmethod
+    def _arg_avals(args, kwargs):
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        return (
+            treedef,
+            tuple((getattr(x, "shape", None), str(getattr(x, "dtype", type(x))))
+                  for x in leaves),
+        )
 
     def __call__(self, *args, **kwargs):
         inner_opt, traced = self._prep_inputs(advance=True)
-        fn = self._compiled if self._compiled is not None else self._jitted
+        fn = self._jitted
+        if self._compiled is not None:
+            # the AOT executable is shape-specialised; a different batch shape
+            # must fall back to the jitted path (which recompiles) not raise
+            if self._arg_avals(args, kwargs) == self._compiled_avals:
+                fn = self._compiled
         loss_val, new_state, new_acc = fn(*traced, args, kwargs)
         # write back into live objects
         for k, t in self._param_tensors.items():

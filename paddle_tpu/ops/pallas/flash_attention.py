@@ -106,9 +106,13 @@ def _fwd_kernel(*refs, scale, causal, block_q, seq, has_sri):
     l = jnp.sum(e, axis=1, keepdims=True)
     o = jax.lax.dot_general(e, v, (((1,), (0,)), ((), ())),
                             preferred_element_type=jnp.float32)
-    o = o / l
+    # Rows with no allowed position (possible under flashmask encodings) must
+    # output exactly zero, not the uniform mean of V; lse=0 for such rows makes
+    # backward's p = exp(_NEG - 0) = 0 so no gradient leaks through them.
+    any_allowed = jnp.any(allowed, axis=1, keepdims=True)
+    o = jnp.where(any_allowed, o / l, jnp.float32(0.0))
     o_ref[0] = o.astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l)  # (BQ, 1) column — Mosaic-friendly 2D store
+    lse_ref[0] = jnp.where(any_allowed, m + jnp.log(l), jnp.float32(0.0))
 
 
 def _mha_fwd(q, k, v, sri, causal, scale, block_q):
