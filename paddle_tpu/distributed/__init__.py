@@ -19,6 +19,10 @@ from .collective import (  # noqa: F401
     recv, reduce, reduce_scatter, scatter, send, wait,
 )
 from .parallel import DataParallel  # noqa: F401
+from . import context_parallel  # noqa: F401
+from .context_parallel import (  # noqa: F401
+    RingFlashAttention, SegmentParallel, ring_attention, ulysses_attention,
+)
 from . import fleet  # noqa: F401
 
 # aliases used in reference code

@@ -22,6 +22,11 @@ from .meta_parallel import (  # noqa: F401
     VocabParallelEmbedding, LayerDesc, SharedLayerDesc, ParallelCrossEntropy,
 )
 from .recompute import recompute, recompute_sequential  # noqa: F401
+from . import sequence_parallel_utils  # noqa: F401
+from .sequence_parallel_utils import (  # noqa: F401
+    AllGatherOp, ColumnSequenceParallelLinear, GatherOp, ReduceScatterOp,
+    RowSequenceParallelLinear, ScatterOp, mark_as_sequence_parallel_parameter,
+)
 
 worker_num = lambda: _fleet.worker_num()
 worker_index = lambda: _fleet.worker_index()

@@ -1,0 +1,176 @@
+"""Megatron sequence parallelism over the 'mp' axis.
+
+Reference: fleet/utils/sequence_parallel_utils.py:85-137 (ScatterOp/GatherOp/
+AllGatherOp/ReduceScatterOp PyLayers), :429 (ColumnSequenceParallelLinear),
+:564 (RowSequenceParallelLinear). There, activations between TP regions are
+split along the sequence dim across the mp group so LayerNorm/dropout memory
+scales with 1/mp, and the TP all-reduce pair becomes all-gather +
+reduce-scatter.
+
+TPU-native: two regimes, matching the rest of the distributed layer.
+
+- **GSPMD (jit over a mesh)**: the ops are sharding constraints — scatter
+  constrains the seq dim to 'mp', gather constrains it replicated, and XLA
+  fuses the RowParallel partial-sum + seq-scatter into one reduce-scatter.
+  No PyLayer is needed: constraint ops are differentiable and the backward
+  collectives fall out of transposition.
+- **Explicit (inside shard_map with 'mp' as a manual axis)**: the same names
+  lower to real lax collectives (all_gather / psum_scatter / dynamic-slice).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...nn.layer import Layer
+from ...tensor import Tensor
+from ..mesh import get_mesh
+from .meta_parallel import _mark_mp_shard, _mp_axis_index
+
+__all__ = [
+    "ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+    "scatter", "all_gather", "reduce_scatter", "mark_as_sequence_parallel_parameter",
+    "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+]
+
+
+def _mp_in_scope():
+    try:
+        jax.lax.axis_index("mp")
+        return True
+    except Exception:
+        return False
+
+
+def _constrain(val, spec_entries):
+    mesh = get_mesh()
+    if mesh is None or _mp_axis_index(mesh) is None:
+        return val
+    if not isinstance(val, jax.core.Tracer):
+        return val
+    return jax.lax.with_sharding_constraint(
+        val, NamedSharding(mesh.jax_mesh, PartitionSpec(*spec_entries)))
+
+
+def _seq_entries(ndim, seq_dim, name):
+    entries = [None] * ndim
+    entries[seq_dim] = name
+    return entries
+
+
+def scatter(x, seq_dim=1):
+    """Full → per-rank sequence shard. Explicit mode: local dynamic slice;
+    GSPMD: constrain seq dim onto 'mp'."""
+    v = x._value if isinstance(x, Tensor) else x
+    if _mp_in_scope():
+        n = jax.lax.psum(1, "mp")
+        me = jax.lax.axis_index("mp")
+        chunk = v.shape[seq_dim] // n
+        out = jax.lax.dynamic_slice_in_dim(v, me * chunk, chunk, axis=seq_dim)
+    else:
+        out = _constrain(v, _seq_entries(v.ndim, seq_dim, "mp"))
+    return Tensor(out) if isinstance(x, Tensor) else out
+
+
+def all_gather(x, seq_dim=1):
+    """Per-rank sequence shard → full sequence on every rank."""
+    v = x._value if isinstance(x, Tensor) else x
+    if _mp_in_scope():
+        out = jax.lax.all_gather(v, "mp", axis=seq_dim, tiled=True)
+    else:
+        out = _constrain(v, _seq_entries(v.ndim, seq_dim, None))
+    return Tensor(out) if isinstance(x, Tensor) else out
+
+
+def reduce_scatter(x, seq_dim=1):
+    """Partial-sum full sequence → reduced per-rank shard (the RowParallel
+    epilogue). GSPMD: psum happens implicitly; constraining the output onto
+    'mp' along seq makes XLA emit reduce-scatter instead of all-reduce."""
+    v = x._value if isinstance(x, Tensor) else x
+    if _mp_in_scope():
+        out = jax.lax.psum_scatter(v, "mp", scatter_dimension=seq_dim, tiled=True)
+    else:
+        out = _constrain(v, _seq_entries(v.ndim, seq_dim, "mp"))
+    return Tensor(out) if isinstance(x, Tensor) else out
+
+
+class _OpFacade:
+    """Reference exposes these as PyLayer classes used via .apply()."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def apply(self, x, *a, **k):
+        return self._fn(x, *a, **k)
+
+    def __call__(self, x, *a, **k):
+        return self._fn(x, *a, **k)
+
+
+ScatterOp = _OpFacade(scatter)
+GatherOp = _OpFacade(all_gather)
+AllGatherOp = _OpFacade(all_gather)
+ReduceScatterOp = _OpFacade(reduce_scatter)
+
+
+def mark_as_sequence_parallel_parameter(param):
+    """Reference marks LN params in SP regions so their grads all-reduce over
+    mp. Under GSPMD replicated params already psum grads across every axis they
+    are replicated over, so this is metadata only."""
+    param.sequence_parallel = True
+    return param
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """Reference sequence_parallel_utils.py:429: input arrives sequence-sharded;
+    all-gather the sequence, matmul a column-sharded weight, leave the output
+    feature-sharded (no gather)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 gather_output=False, mp_group=None, name=None):
+        super().__init__()
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierUniform(),
+        )
+        _mark_mp_shard(self.weight, 1)
+        self.bias = (self.create_parameter([out_features], is_bias=True)
+                     if has_bias else None)
+        if self.bias is not None:
+            _mark_mp_shard(self.bias, 0)
+
+    def forward(self, x):
+        x = all_gather(x, seq_dim=1)
+        out = F.linear(x, self.weight, self.bias)
+        if not self.gather_output:
+            out._value = _constrain(
+                out._value, [None] * (out.ndim - 1) + ["mp"])
+        return out
+
+
+class RowSequenceParallelLinear(Layer):
+    """Reference sequence_parallel_utils.py:564: row-sharded weight; the
+    partial-sum output is reduce-scattered along the sequence dim."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=True, mp_group=None, name=None):
+        super().__init__()
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierUniform(),
+        )
+        _mark_mp_shard(self.weight, 0)
+        self.bias = (self.create_parameter([out_features], is_bias=True)
+                     if has_bias else None)
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, None)
+        out = reduce_scatter(out, seq_dim=1)
+        if self.bias is not None:
+            out = out + self.bias
+        return out
