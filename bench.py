@@ -1,15 +1,19 @@
 #!/usr/bin/env python
-"""Driver benchmark: ResNet-50 training throughput (BASELINE.json config 1).
+"""Driver benchmark. Prints ONE JSON line.
 
-Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}.
+Headline metric (BASELINE north star is LLM MFU): GPT-medium-style causal-LM
+training on one chip — tokens/sec + MFU with the Pallas flash-attention kernel
+engaged (S=1024 >= the kernel threshold). The ResNet-50 result (BASELINE
+config 1) rides along under the "resnet50" key.
+
 Self-auditing (VERDICT r1 item 1b):
-  * FLOPs come from the compiled program's own cost_analysis(), so the reported
-    `mfu` is achieved-FLOPs vs the chip's bf16 peak — a >100% MFU means the
-    measurement is broken and the bench aborts rather than publish it.
-  * The compiled HLO is checked to actually contain the conv backward pass
-    (convolution op count ~= 3x the 53 forward convs of ResNet-50).
-  * Steps serialize through the donated param state (step i+1 consumes step i's
-    updated params), and the timer blocks on the final state, not just the loss.
+  * FLOPs come from the compiled program's own cost_analysis(), so `mfu` is
+    achieved-FLOPs vs the chip's bf16 peak — >100% MFU aborts the report.
+  * The GPT HLO is checked for the Mosaic custom-call (flash kernel actually
+    compiled in) and the ResNet HLO for backward convolutions.
+  * Steps serialize through the donated param state; the timer blocks on a
+    device-to-host fetch of the final loss and a post-update parameter
+    (block_until_ready alone can return early under tunneled device plugins).
 """
 import json
 import os
@@ -34,7 +38,7 @@ _PEAK_BF16 = {
 }
 
 
-def _chip_peak(device) -> float | None:
+def _chip_peak(device):
     kind = getattr(device, "device_kind", "")
     for name, peak in _PEAK_BF16.items():
         if kind.startswith(name):
@@ -42,23 +46,100 @@ def _chip_peak(device) -> float | None:
     return None
 
 
-def main():
+def _cost_flops(compiled):
+    cost = compiled.cost_analysis() or {}
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    return float(cost.get("flops", 0.0))
+
+
+def _timed_steps(step, args, kwargs, steps, sync_param):
     import jax
 
+    step(*args, **kwargs)            # warmup 1 (installs jit cache path if needed)
+    float(step(*args, **kwargs))     # warmup 2, hard sync
+    t0 = time.perf_counter()
+    loss = None
+    for _ in range(steps):
+        loss = step(*args, **kwargs)
+    lv = float(loss)
+    np.asarray(jax.device_get(sync_param._value))
+    dt = time.perf_counter() - t0
+    return dt, lv
+
+
+def bench_gpt(on_accel, dev):
+    import paddle_tpu as paddle
+    from paddle_tpu.jit.train import TrainStep
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    if on_accel:
+        # ~350M params (GPT-medium class): fits one v5e chip with Adam state
+        cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
+                        num_heads=16, max_position=1024, use_rope=True,
+                        use_rms_norm=True, use_swiglu=True)
+        B, S, steps = 8, 1024, 20
+    else:
+        cfg = GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                        num_heads=4, max_position=128)
+        B, S, steps = 2, 64, 2
+
+    model = GPTForCausalLM(cfg)
+    if on_accel:
+        paddle.amp.decorate(model, level="O2", dtype="bfloat16")
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters(),
+                                 multi_precision=on_accel)
+    step = TrainStep(model, lambda logits, loss: loss, opt)
+
+    ids = np.random.randint(0, cfg.vocab_size, (B, S)).astype(np.int64)
+    x = paddle.to_tensor(ids)
+    y = paddle.to_tensor(np.roll(ids, -1, axis=1))
+
+    compiled = step.aot_prime(x, labels=y)
+    flops = _cost_flops(compiled)
+    hlo = compiled.as_text()
+    flash_kernel = ("tpu_custom_call" in hlo) or ("CustomCall" in hlo and
+                                                  "Mosaic" in hlo)
+
+    small_param = min(model.parameters(), key=lambda t: t.size)
+    dt, loss = _timed_steps(step, (x,), {"labels": y}, steps, small_param)
+    tokens_per_sec = B * S * steps / dt
+
+    peak = _chip_peak(dev) if on_accel else None
+    mfu = None
+    audit = "ok"
+    if flops <= 0:
+        audit = "flops-unavailable"
+    elif peak:
+        mfu = flops * steps / dt / peak
+        if mfu > 1.0:
+            return None, {"error": f"GPT MFU {mfu:.2f} > 100% — timing broken"}
+    result = {
+        "tokens_per_sec": round(tokens_per_sec, 1),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "audit": audit,
+        "step_gflops": round(flops / 1e9, 1),
+        "flash_kernel_in_hlo": bool(flash_kernel),
+        "batch": B, "seq_len": S,
+        "loss": round(loss, 4),
+    }
+    return result, None
+
+
+def bench_resnet(on_accel, dev):
     import paddle_tpu as paddle
     from paddle_tpu import nn
     from paddle_tpu.jit.train import TrainStep
 
-    dev = jax.devices()[0]
-    on_accel = dev.platform not in ("cpu",)
     batch = 128 if on_accel else 4
     img = 224 if on_accel else 64
-    steps = 30 if on_accel else 3
+    steps = 30 if on_accel else 2
 
     paddle.seed(0)
     model = paddle.vision.models.resnet50(num_classes=1000)
     if on_accel:
-        # bf16 params + activations: the TPU-native precision for conv/matmul
         paddle.amp.decorate(model, level="O2", dtype="bfloat16")
     loss_fn = nn.CrossEntropyLoss()
     opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
@@ -67,77 +148,77 @@ def main():
     step = TrainStep(model, lambda out, y: loss_fn(out, y), opt)
 
     x = paddle.to_tensor(
-        np.random.randn(batch, 3, img, img).astype("bfloat16" if on_accel else "float32")
-    )
+        np.random.randn(batch, 3, img, img).astype(
+            "bfloat16" if on_accel else "float32"))
     y = paddle.to_tensor(np.random.randint(0, 1000, batch).astype("int64"))
 
-    # ---- audit: FLOPs + HLO content from the AOT-compiled program (also installs
-    # the executable so the timed loop reuses it — single compilation).
     compiled = step.aot_prime(x, y)
-    cost = compiled.cost_analysis() or {}
-    if isinstance(cost, (list, tuple)):  # older jax returns [dict]
-        cost = cost[0] if cost else {}
-    step_flops = float(cost.get("flops", 0.0))
+    flops = _cost_flops(compiled)
     hlo = compiled.as_text()
-    # count convolution *instructions* (opcode position after '='), not substrings
     n_conv = len(re.findall(r"=\s*\S*\s*convolution\(", hlo))
-    if n_conv < 100:
-        print(json.dumps({
-            "metric": "resnet50_train_images_per_sec", "value": 0.0,
-            "unit": "images/sec", "vs_baseline": None,
-            "error": f"compiled HLO has only {n_conv} convolution ops — "
-                     f"backward pass missing; refusing to report throughput",
-        }))
-        return
+    if on_accel and n_conv < 100:
+        return None, {"error": f"ResNet HLO has only {n_conv} convolutions — "
+                               f"backward missing"}
 
-    # warmup / compile (hard sync: fetch the loss to host)
-    step(x, y)
-    float(step(x, y))
-    # Timed loop. Each step consumes the previous step's donated state (TrainStep
-    # threads params through), so the steps form a dependency chain. Sync is a
-    # device-to-host FETCH of the final loss and a post-update parameter —
-    # block_until_ready alone can return early under tunneled device plugins
-    # (that is exactly the round-1 19k img/s measurement bug).
     small_param = min(model.parameters(), key=lambda t: t.size)
-    t0 = time.perf_counter()
-    loss = None
-    for _ in range(steps):
-        loss = step(x, y)
-    float(loss)
-    np.asarray(jax.device_get(small_param._value))
-    dt = time.perf_counter() - t0
+    dt, _ = _timed_steps(step, (x, y), {}, steps, small_param)
     ips = batch * steps / dt
 
     peak = _chip_peak(dev) if on_accel else None
     mfu = None
     audit = "ok"
-    if step_flops <= 0:
-        audit = "flops-unavailable"  # cost_analysis gave 0/-1: MFU audit impossible
+    if flops <= 0:
+        audit = "flops-unavailable"
     elif peak:
-        mfu = step_flops * steps / dt / peak
+        mfu = flops * steps / dt / peak
         if mfu > 1.0:
-            print(json.dumps({
-                "metric": "resnet50_train_images_per_sec", "value": 0.0,
-                "unit": "images/sec", "vs_baseline": None,
-                "error": f"measured MFU {mfu:.2f} exceeds 100% of {dev.device_kind} "
-                         f"peak — timing is broken; refusing to report",
-                "step_gflops": round(step_flops / 1e9, 1),
-                "raw_images_per_sec": round(ips, 2),
-            }))
-            return
-
-    print(json.dumps({
-        "metric": "resnet50_train_images_per_sec" if on_accel
-        else "resnet50_train_images_per_sec_cpu_smoke",
-        "value": round(ips, 2),
-        "unit": "images/sec",
-        "vs_baseline": None,
+            return None, {"error": f"ResNet MFU {mfu:.2f} > 100% — timing broken"}
+    return {
+        "images_per_sec": round(ips, 2),
         "mfu": round(mfu, 4) if mfu is not None else None,
         "audit": audit,
-        "step_gflops": round(step_flops / 1e9, 1),
+        "step_gflops": round(flops / 1e9, 1),
         "hlo_convolutions": n_conv,
-        "device": getattr(dev, "device_kind", dev.platform),
-    }))
+        "batch": batch,
+    }, None
+
+
+def main():
+    import jax
+
+    dev = jax.devices()[0]
+    on_accel = dev.platform not in ("cpu",)
+
+    gpt, gpt_err = bench_gpt(on_accel, dev)
+    try:
+        resnet, resnet_err = bench_resnet(on_accel, dev)
+    except Exception as e:  # resnet must not sink the GPT headline
+        resnet, resnet_err = None, {"error": repr(e)[:200]}
+
+    suffix = "" if on_accel else "_cpu_smoke"
+    if gpt is not None:
+        out = {
+            "metric": f"gpt350m_train_tokens_per_sec{suffix}",
+            "value": gpt["tokens_per_sec"],
+            "unit": "tokens/sec",
+            "vs_baseline": None,
+            "mfu": gpt["mfu"],
+            "audit": gpt["audit"],
+            "gpt": gpt,
+            "resnet50": resnet if resnet is not None else resnet_err,
+            "device": getattr(dev, "device_kind", dev.platform),
+        }
+    else:
+        out = {
+            "metric": f"resnet50_train_images_per_sec{suffix}",
+            "value": resnet["images_per_sec"] if resnet else 0.0,
+            "unit": "images/sec",
+            "vs_baseline": None,
+            "gpt_error": gpt_err,
+            "resnet50": resnet if resnet is not None else resnet_err,
+            "device": getattr(dev, "device_kind", dev.platform),
+        }
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

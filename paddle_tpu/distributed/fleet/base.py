@@ -89,6 +89,7 @@ class HybridCommunicateGroup:
                     cfg["sep_degree"], cfg["mp_degree"]]
             topology = CommunicateTopology(self.AXES, dims)
         self._topo = topology
+        self._strategy = strategy
         self.nranks = topology.world_size()
         self.global_rank = env.get_rank() if env.get_world_size() > 1 else 0
         dims = [topology.get_dim(a) for a in self.AXES]

@@ -23,7 +23,7 @@ from ...nn.layer import Layer
 from ...nn.layer_common import LayerList
 from ...tensor import Tensor
 from ..api import shard_tensor
-from ..mesh import Replicate, Shard, get_mesh
+from ..mesh import Replicate, Shard, constrain, get_mesh
 
 
 def _mp_axis_index(mesh):
@@ -83,17 +83,11 @@ class ColumnParallelLinear(Layer):
 
     def forward(self, x):
         out = F.linear(x, self.weight, self.bias)
-        mesh = get_mesh()
-        idx = _mp_axis_index(mesh)
-        if not self.gather_output and mesh is not None and idx is not None and \
-                isinstance(out._value, jax.core.Tracer):
-            # keep activation sharded on last dim along mp
-            from jax.sharding import PartitionSpec
-
-            spec = [None] * (out.ndim - 1) + ["mp"]
-            out._value = jax.lax.with_sharding_constraint(
-                out._value, jax.sharding.NamedSharding(mesh.jax_mesh, PartitionSpec(*spec))
-            )
+        if not self.gather_output:
+            # keep activation sharded on last dim along mp (targets the stage
+            # sub-mesh inside pipeline programs via the compute-mesh override)
+            out._value = constrain(
+                out._value, [None] * (out.ndim - 1) + ["mp"])
         return out
 
 
@@ -275,19 +269,46 @@ class PipelineParallel(Layer):
         return self._layers(*args, **kwargs)
 
     # ------------------------------------------------------------------ engine
-    def _stage_devices(self, num_stages):
+    def _stage_placements(self, num_stages):
+        """One placement per physical stage. With a global mesh carrying a 'pp'
+        axis plus dp/mp axes, each stage gets the SUB-MESH at its pp coordinate
+        (hybrid PP×DP×TP×ZeRO composition); otherwise one device per stage."""
+        from jax.sharding import Mesh as JaxMesh
+
+        from .pipeline import StagePlacement
+
         devs = jax.devices()
         if self._hcg is not None and getattr(self._hcg, "mesh", None) is not None:
             mesh = self._hcg.mesh
             if "pp" in mesh.dim_names:
-                # first device of each pp coordinate (dp/mp submesh placement of
-                # activations inside a stage comes from the params' shardings)
-                grid = np.moveaxis(
-                    np.asarray(mesh.jax_mesh.devices),
-                    mesh.dim_names.index("pp"), 0,
-                )
-                return [grid[i].reshape(-1)[0] for i in range(grid.shape[0])]
-        return [devs[i % len(devs)] for i in range(num_stages)]
+                pp_idx = mesh.dim_names.index("pp")
+                grid = np.moveaxis(np.asarray(mesh.jax_mesh.devices), pp_idx, 0)
+                other_axes = tuple(n for i, n in enumerate(mesh.dim_names)
+                                   if i != pp_idx)
+                zero = self._zero_stage()
+                placements = []
+                for i in range(grid.shape[0]):
+                    sub = grid[i]
+                    if sub.size == 1:
+                        placements.append(StagePlacement(
+                            device=sub.reshape(-1)[0]))
+                    else:
+                        placements.append(StagePlacement(
+                            mesh=JaxMesh(sub, other_axes), zero_stage=zero))
+                return placements
+        return [StagePlacement(device=devs[i % len(devs)])
+                for i in range(num_stages)]
+
+    def _zero_stage(self) -> int:
+        hcg = self._hcg
+        strat = getattr(hcg, "_strategy", None) if hcg is not None else None
+        if strat is None:
+            return 0
+        try:
+            return int((strat.sharding_configs or {}).get("stage", 0)) if \
+                getattr(strat, "sharding", False) else 0
+        except Exception:
+            return 0
 
     def _build_engine(self):
         from .pipeline import PipelineEngine, _Chunk
@@ -300,10 +321,10 @@ class PipelineParallel(Layer):
             _Chunk([self._layers.run_function[i] for i in range(bounds[c], bounds[c + 1])])
             for c in range(n_chunks)
         ]
-        stage_devs = self._stage_devices(p)
+        stage_places = self._stage_placements(p)
         # VPP placement: chunk c lives on stage c % p (reference :1308)
-        devices = [stage_devs[c % p] for c in range(n_chunks)]
-        self._engine = PipelineEngine(chunks, devices, self._layers.loss_fn)
+        placements = [stage_places[c % p] for c in range(n_chunks)]
+        self._engine = PipelineEngine(chunks, placements, self._layers.loss_fn)
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         from ...ops.manipulation import split

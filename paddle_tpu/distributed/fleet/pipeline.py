@@ -6,10 +6,18 @@ pp_utils/p2p_communication.py (p2p transfers).
 
 TPU-native design (SURVEY.md §7.3 item 1): XLA wants one program per launch, so a
 pipeline schedule is a HOST-side loop dispatching per-stage compiled programs.
-Each stage chunk compiles to its own XLA executable pinned to its stage device
-(device_put of boundary activations = the p2p transfer, riding ICI between
-chips); jax's async dispatch overlaps stages automatically — correctness comes
-from dataflow, the 1F1B instruction order controls in-flight activation memory.
+Each stage chunk compiles to its own XLA executable pinned to its stage
+placement; boundary activations move with device_put (ICI p2p on TPU); jax's
+async dispatch overlaps stages automatically — correctness comes from dataflow,
+the 1F1B instruction order controls in-flight activation memory.
+
+**Hybrid composition** (VERDICT r2 item 1): a stage placement is either a single
+device or a SUB-MESH with ('dp', 'mp') axes carved out of the global
+(pp, dp, mp) mesh. Inside a stage program GSPMD handles TP (params sharded over
+'mp' per their _dist_attr) and DP (batch sharded over 'dp', gradient psum
+emitted by transposition); ZeRO stages lower to dim-0 'dp' sharding constraints
+on grads (stage>=2) and params (stage 3) exactly as in jit/train.py. The p2p
+device_put between stage meshes is an ICI resharding transfer.
 
 Backward recomputes the stage forward inside `jax.vjp` (per-stage remat): only
 boundary activations are ever stored, which is the same activation footprint the
@@ -21,6 +29,7 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ...autograd import tape
 from ...nn.layer import Layer
@@ -45,14 +54,127 @@ def _is_trainable(t: Tensor) -> bool:
     return not t.stop_gradient and jnp.issubdtype(t.dtype, jnp.floating)
 
 
+class StagePlacement:
+    """Where one pipeline stage lives: a single device, or a jax Mesh whose
+    axes ('dp'/'mp'/...) partition the stage's compute. Derives per-tensor
+    shardings for params (TP placements from _dist_attr + optional ZeRO),
+    activations (batch over 'dp') and gradients (ZeRO>=2: dim-0 over 'dp')."""
+
+    def __init__(self, device=None, mesh: Mesh | None = None, zero_stage: int = 0):
+        assert (device is None) != (mesh is None)
+        self.device = device
+        self.mesh = mesh
+        self.zero_stage = zero_stage
+        if mesh is not None:
+            sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+            # batch splits over dp and the ZeRO 'sharding' axis (fleet keeps
+            # them distinct, topology.py:199); sequence over 'sep'
+            self.batch_axes = tuple(
+                a for a in ("dp", "sharding") if sizes.get(a, 1) > 1)
+            self.seq_axis = "sep" if sizes.get("sep", 1) > 1 else None
+            self.zero_axis = ("sharding" if sizes.get("sharding", 1) > 1
+                              else ("dp" if sizes.get("dp", 1) > 1 else None))
+        else:
+            self.batch_axes = ()
+            self.seq_axis = None
+            self.zero_axis = None
+
+    @property
+    def representative_device(self):
+        if self.device is not None:
+            return self.device
+        return list(self.mesh.devices.reshape(-1))[0]
+
+    def _axis_size(self, name):
+        if name is None:
+            return 1
+        sizes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        if isinstance(name, tuple):
+            n = 1
+            for a in name:
+                n *= sizes.get(a, 1)
+            return n
+        return sizes.get(name, 1)
+
+    # -- shardings -----------------------------------------------------------
+    def param_spec(self, t: Tensor) -> PartitionSpec | None:
+        if self.mesh is None:
+            return None
+        entries = [None] * max(t.ndim, 0)
+        dist = getattr(t, "_dist_attr", None)
+        if dist is not None and dist[1] is not None:
+            src_mesh, placements = dist
+            for mesh_dim, pl in enumerate(placements):
+                name = src_mesh.dim_names[mesh_dim] if mesh_dim < len(
+                    src_mesh.dim_names) else None
+                if (name in self.mesh.axis_names and pl is not None
+                        and getattr(pl, "is_shard", lambda: False)()):
+                    d = pl.get_dim()
+                    if entries[d] is None and t.shape[d] % self._axis_size(name) == 0:
+                        entries[d] = name
+        if (self.zero_stage >= 3 and entries and entries[0] is None
+                and self.zero_axis is not None and t.ndim > 0
+                and t.shape[0] % self._axis_size(self.zero_axis) == 0):
+            entries[0] = self.zero_axis
+        return PartitionSpec(*entries)
+
+    def param_sharding(self, t: Tensor):
+        spec = self.param_spec(t)
+        return None if spec is None else NamedSharding(self.mesh, spec)
+
+    def act_spec(self, shape) -> PartitionSpec:
+        """Batch dim over (dp, sharding); seq dim (1) over sep when divisible."""
+        entries: list = [None] * len(shape)
+        if shape and self.batch_axes:
+            ba = self.batch_axes
+            while ba and shape[0] % self._axis_size(ba) != 0:
+                ba = ba[:-1]  # drop trailing axes until the batch dim divides
+            if ba:
+                entries[0] = ba if len(ba) > 1 else ba[0]
+        if (len(shape) >= 2 and self.seq_axis is not None
+                and shape[1] % self._axis_size(self.seq_axis) == 0):
+            entries[1] = self.seq_axis
+        return PartitionSpec(*entries)
+
+    def grad_spec(self, shape) -> PartitionSpec | None:
+        """ZeRO>=2: gradients sharded dim-0 along the zero axis (turns the dp
+        gradient all-reduce into reduce-scatter inside the stage program)."""
+        if self.mesh is None or self.zero_stage < 2 or self.zero_axis is None:
+            return None
+        n = self._axis_size(self.zero_axis)
+        if not shape or shape[0] % n != 0:
+            return None
+        return PartitionSpec(self.zero_axis, *([None] * (len(shape) - 1)))
+
+    # -- placement ops -------------------------------------------------------
+    def put_param(self, val, t: Tensor):
+        if self.device is not None:
+            return jax.device_put(val, self.device)
+        sh = self.param_sharding(t)
+        return jax.device_put(val, sh) if sh is not None else jax.device_put(
+            val, NamedSharding(self.mesh, PartitionSpec()))
+
+    def put_act(self, val):
+        if self.device is not None:
+            return jax.device_put(val, self.device)
+        spec = self.act_spec(tuple(getattr(val, "shape", ())))
+        return jax.device_put(val, NamedSharding(self.mesh, spec))
+
+
+def _as_placement(p) -> StagePlacement:
+    if isinstance(p, StagePlacement):
+        return p
+    return StagePlacement(device=p)
+
+
 class _StageExec:
     """Compiled forward / backward / fused-loss-step programs for one chunk,
-    pinned to one device. Mirrors the per-(stage, phase) executable Plan of the
-    reference's static pipeline (new_executor/interpreter/plan.h)."""
+    pinned to one stage placement. Mirrors the per-(stage, phase) executable
+    Plan of the reference's static pipeline (new_executor/interpreter/plan.h)."""
 
-    def __init__(self, chunk: _Chunk, device, loss_fn: Callable | None = None):
+    def __init__(self, chunk: _Chunk, placement, loss_fn: Callable | None = None):
         self.chunk = chunk
-        self.device = device
+        self.placement = _as_placement(placement)
         self.loss_fn = loss_fn
         sd = chunk.state_dict()
         self.param_tensors = dict(sd)
@@ -61,29 +183,59 @@ class _StageExec:
         self._fwd = jax.jit(self._fwd_fn)
         self._bwd = jax.jit(self._bwd_fn)
         self._last = jax.jit(self._last_fn)
+        self._state_cache = None  # (tr, fz) reused across micro-batches/steps
 
     # -- state handling ------------------------------------------------------
     def place_params(self, placed: dict):
-        """Pin each owned parameter to this stage's device (first stage to see a
-        shared tensor owns it; later stages get per-batch copies)."""
+        """Pin each owned parameter to this stage's placement (first stage to
+        see a shared tensor owns it; later stages get per-batch copies)."""
         for k, t in self.param_tensors.items():
             if id(t) not in placed:
-                t._value = jax.device_put(t._value, self.device)
-                placed[id(t)] = self.device
+                t._value = self.placement.put_param(t._value, t)
+                placed[id(t)] = self.placement
 
     def states(self):
-        tr = {k: jax.device_put(self.param_tensors[k]._value, self.device)
-              for k in self.trainable_keys}
-        fz = {k: jax.device_put(self.param_tensors[k]._value, self.device)
-              for k in self.frozen_keys}
+        """Parameter pytrees for the stage programs, placed on this stage.
+        Cross-stage shared params get a per-step copy here; a value-identity
+        cache avoids re-placing unchanged params every micro-batch/train_batch
+        (VERDICT r2 weak #6 per-step device_put overhead)."""
+        if self._state_cache is None:
+            self._state_cache = {}
+        cache = self._state_cache
+
+        def place(k):
+            t = self.param_tensors[k]
+            hit = cache.get(k)
+            if hit is not None and hit[0] is t._value:
+                return hit[1]
+            pv = self.placement.put_param(t._value, t)
+            cache[k] = (t._value, pv)
+            return pv
+
+        tr = {k: place(k) for k in self.trainable_keys}
+        fz = {k: place(k) for k in self.frozen_keys}
         return tr, fz
 
     # -- traced programs -----------------------------------------------------
     def _call_chunk(self, tr, fz, x):
+        from ..mesh import compute_mesh
+
         full = dict(fz)
         full.update(tr)
-        with tape.no_grad():
+        # model-code sharding constraints must target THIS stage's sub-mesh,
+        # not the global (pp, ...) mesh
+        with compute_mesh(self.placement.mesh), tape.no_grad():
             out = self.chunk.functional_call(full, Tensor(x))
+        return out
+
+    def _constrain_grads(self, dtr):
+        out = {}
+        for k, g in dtr.items():
+            spec = self.placement.grad_spec(tuple(g.shape))
+            if spec is not None:
+                g = jax.lax.with_sharding_constraint(
+                    g, NamedSharding(self.placement.mesh, spec))
+            out[k] = g
         return out
 
     def _fwd_fn(self, tr, fz, x):
@@ -96,7 +248,7 @@ class _StageExec:
 
         _, vjp = jax.vjp(f, tr, x)
         dtr, dx = vjp(gy)
-        return dtr, dx
+        return self._constrain_grads(dtr), dx
 
     def _last_fn(self, tr, fz, x, label, loss_scale):
         def f(tr, x):
@@ -108,19 +260,19 @@ class _StageExec:
 
         grad_fn = jax.value_and_grad(f, argnums=(0, 1), has_aux=True)
         (_, loss), (dtr, dx) = grad_fn(tr, x)
-        return loss, dtr, dx
+        return loss, self._constrain_grads(dtr), dx
 
     # -- dispatch ------------------------------------------------------------
     def forward(self, tr, fz, x):
-        return self._fwd(tr, fz, jax.device_put(x, self.device))
+        return self._fwd(tr, fz, self.placement.put_act(x))
 
     def backward(self, tr, fz, x, gy):
-        return self._bwd(tr, fz, jax.device_put(x, self.device),
-                         jax.device_put(gy, self.device))
+        return self._bwd(tr, fz, self.placement.put_act(x),
+                         self.placement.put_act(gy))
 
     def last_step(self, tr, fz, x, label, loss_scale):
-        return self._last(tr, fz, jax.device_put(x, self.device),
-                          jax.device_put(label, self.device), loss_scale)
+        return self._last(tr, fz, self.placement.put_act(x),
+                          self.placement.put_act(label), loss_scale)
 
 
 def _1f1b_instructions(num_stages: int, num_micro: int):
@@ -144,23 +296,27 @@ def _1f1b_instructions(num_stages: int, num_micro: int):
 
 
 class PipelineEngine:
-    """Executes a chunk chain over stage devices with per-stage 1F1B streams.
+    """Executes a chunk chain over stage placements with per-stage 1F1B streams.
 
-    chunks[i] feeds chunks[i+1]; chunk i is placed on devices[i]. For plain PP
-    the chain length equals the stage count; for interleaved VPP the chain is
-    num_stages * virtual_pp_degree chunks placed round-robin (chunk c on device
-    c % num_stages), reproducing the reference's VPP placement
-    (pipeline_parallel.py:1308)."""
+    chunks[i] feeds chunks[i+1]; chunk i is placed on placements[i] (a device or
+    a StagePlacement sub-mesh). For plain PP the chain length equals the stage
+    count; for interleaved VPP the chain is num_stages * virtual_pp_degree
+    chunks placed round-robin (chunk c on placement c % num_stages),
+    reproducing the reference's VPP placement (pipeline_parallel.py:1308)."""
 
-    def __init__(self, chunks, devices, loss_fn):
+    def __init__(self, chunks, placements, loss_fn):
         self.execs = [
-            _StageExec(c, devices[i], loss_fn if i == len(chunks) - 1 else None)
+            _StageExec(c, placements[i], loss_fn if i == len(chunks) - 1 else None)
             for i, c in enumerate(chunks)
         ]
         placed: dict = {}
         for ex in self.execs:
             ex.place_params(placed)
         self._placed = placed
+
+    def invalidate_states(self):
+        for ex in self.execs:
+            ex._state_cache = None
 
     def run(self, micro_inputs, micro_labels, loss_scale=1.0):
         """One accumulation window. Returns (mean_loss, {id(param): grad})."""
@@ -192,8 +348,8 @@ class PipelineEngine:
                     return  # fused into B (loss fwd+bwd in one program)
                 y = ex.forward(tr, fz, acts_in[s][mb])
                 # p2p send: move the boundary activation to the next stage's
-                # device now (ICI transfer overlaps with ongoing compute)
-                acts_in[s + 1][mb] = jax.device_put(y, self.execs[s + 1].device)
+                # placement now (ICI transfer overlaps with ongoing compute)
+                acts_in[s + 1][mb] = self.execs[s + 1].placement.put_act(y)
                 return
             x = acts_in[s][mb]
             if s == n_chunks - 1:
@@ -204,7 +360,7 @@ class PipelineEngine:
                 dtr, dx = ex.backward(tr, fz, x, grads_in[s][mb])
             del acts_in[s][mb]
             if s > 0:
-                grads_in[s - 1][mb] = jax.device_put(dx, self.execs[s - 1].device)
+                grads_in[s - 1][mb] = self.execs[s - 1].placement.put_act(dx)
             acc_grads[s] = dtr if acc_grads[s] is None else jax.tree_util.tree_map(
                 jnp.add, acc_grads[s], dtr
             )
@@ -225,18 +381,21 @@ class PipelineEngine:
                 raise RuntimeError("pipeline schedule deadlocked (bug)")
 
         # map accumulated grads back to live parameter tensors (shared layers:
-        # grads from multiple chunks sum onto the owner's device)
+        # grads from multiple chunks sum onto the owner's placement)
         grads_by_param: dict = {}
         for s, ex in enumerate(self.execs):
             if acc_grads[s] is None:
                 continue
             for k, g in acc_grads[s].items():
                 t = ex.param_tensors[k]
-                dev = self._placed[id(t)]
-                g = jax.device_put(g, dev)
+                pl = self._placed[id(t)]
+                # grads have param shape: the owner's param layout is the right
+                # home (only actually moves data for cross-stage shared params)
+                g = pl.put_param(g, t)
                 if id(t) in grads_by_param:
                     grads_by_param[id(t)] = (t, grads_by_param[id(t)][1] + g)
                 else:
                     grads_by_param[id(t)] = (t, g)
-        mean_loss = sum(jax.device_put(l, self.execs[-1].device) for l in losses) / m
+        last_dev = self.execs[-1].placement.representative_device
+        mean_loss = sum(jax.device_put(l, last_dev) for l in losses) / m
         return mean_loss, grads_by_param

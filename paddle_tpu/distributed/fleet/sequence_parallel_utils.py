@@ -45,14 +45,10 @@ def _mp_in_scope():
         return False
 
 
-def _constrain(val, spec_entries):
-    mesh = get_mesh()
-    if mesh is None or _mp_axis_index(mesh) is None:
-        return val
-    if not isinstance(val, jax.core.Tracer):
-        return val
-    return jax.lax.with_sharding_constraint(
-        val, NamedSharding(mesh.jax_mesh, PartitionSpec(*spec_entries)))
+def _constrain(val, spec_entries, force=False):
+    from ..mesh import constrain as _mesh_constrain
+
+    return _mesh_constrain(val, spec_entries, force=force)
 
 
 def _seq_entries(ndim, seq_dim, name):
@@ -81,7 +77,7 @@ def all_gather(x, seq_dim=1):
     if _mp_in_scope():
         out = jax.lax.all_gather(v, "mp", axis=seq_dim, tiled=True)
     else:
-        out = _constrain(v, _seq_entries(v.ndim, seq_dim, None))
+        out = _constrain(v, _seq_entries(v.ndim, seq_dim, None), force=True)
     return Tensor(out) if isinstance(x, Tensor) else out
 
 

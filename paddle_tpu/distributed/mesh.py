@@ -209,3 +209,79 @@ def spec_for(mesh: ProcessMesh, placements, ndim) -> PartitionSpec:
 
 def sharding_for(mesh: ProcessMesh, placements, ndim) -> NamedSharding:
     return NamedSharding(mesh.jax_mesh, spec_for(mesh, placements, ndim))
+
+
+# --------------------------------------------------------------- compute mesh
+# Pipeline stage programs trace model code on a SUB-mesh of the global mesh;
+# sharding constraints written against the global mesh would reference devices
+# outside the stage. Stage executables set this override while tracing.
+_compute_mesh_override = None
+_NO_MESH = object()  # explicit "no constraints" override (single-device stage)
+
+
+class _ComputeMeshCtx:
+    def __init__(self, jax_mesh):
+        self._mesh = jax_mesh if jax_mesh is not None else _NO_MESH
+        self._prev = None
+
+    def __enter__(self):
+        global _compute_mesh_override
+        self._prev = _compute_mesh_override
+        _compute_mesh_override = self._mesh
+        return self._mesh
+
+    def __exit__(self, *exc):
+        global _compute_mesh_override
+        _compute_mesh_override = self._prev
+        return False
+
+
+def compute_mesh(jax_mesh) -> _ComputeMeshCtx:
+    """Context manager: route model-code sharding constraints to `jax_mesh`
+    (None = suppress constraints entirely, for single-device stage programs)."""
+    return _ComputeMeshCtx(jax_mesh)
+
+
+def current_jax_mesh():
+    """The jax Mesh that sharding constraints in model code should target: the
+    stage-program override when active, else the global ProcessMesh's mesh."""
+    if _compute_mesh_override is _NO_MESH:
+        return None
+    if _compute_mesh_override is not None:
+        return _compute_mesh_override
+    m = get_mesh()
+    return m.jax_mesh if m is not None else None
+
+
+def constrain(val, entries, force=False):
+    """with_sharding_constraint(val, entries) against the current compute mesh,
+    dropping axis names the mesh doesn't carry and axes that don't divide.
+    entries: list of axis-name / tuple / None per tensor dim. No-op outside a
+    trace or without a mesh. With force=True an all-replicated result still
+    emits the constraint (used to demand an all-gather)."""
+    import jax as _jax
+
+    jm = current_jax_mesh()
+    if jm is None or not isinstance(val, _jax.core.Tracer):
+        return val
+    sizes = dict(zip(jm.axis_names, jm.devices.shape))
+
+    def keep(names, dim_size):
+        if names is None:
+            return None
+        tup = names if isinstance(names, tuple) else (names,)
+        tup = tuple(n for n in tup if sizes.get(n, 1) > 1)
+        if not tup:
+            return None
+        total = 1
+        for n in tup:
+            total *= sizes[n]
+        if dim_size % total != 0:
+            return None
+        return tup if len(tup) > 1 else tup[0]
+
+    kept = [keep(e, val.shape[i]) for i, e in enumerate(entries)]
+    if all(k is None for k in kept) and not force:
+        return val
+    return _jax.lax.with_sharding_constraint(
+        val, NamedSharding(jm, PartitionSpec(*kept)))

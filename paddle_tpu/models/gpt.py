@@ -52,23 +52,15 @@ class GPTConfig:
 
 def _shard_seq(x):
     """Constrain activations to a ('dp','sep') batch/seq layout when a mesh exists —
-    the sequence-parallel (SEP axis) recipe."""
-    mesh = get_mesh()
-    if mesh is None or not isinstance(x._value, jax.core.Tracer):
-        return x
-    names = mesh.dim_names
-    if "dp" not in names and "sep" not in names:
-        return x
-    from jax.sharding import NamedSharding, PartitionSpec
+    the sequence-parallel (SEP axis) recipe. Targets the stage sub-mesh inside
+    pipeline programs via the compute-mesh override."""
+    from paddle_tpu.distributed.mesh import constrain
 
     entries = [None] * x.ndim
-    if "dp" in names and mesh.get_dim_size("dp") > 1:
-        entries[0] = "dp"
-    if "sep" in names and x.ndim >= 2 and mesh.get_dim_size("sep") > 1:
+    entries[0] = "dp"
+    if x.ndim >= 2:
         entries[1] = "sep"
-    x._value = jax.lax.with_sharding_constraint(
-        x._value, NamedSharding(mesh.jax_mesh, PartitionSpec(*entries))
-    )
+    x._value = constrain(x._value, entries)
     return x
 
 
@@ -225,3 +217,82 @@ def llama2_7b():
 def gpt_tiny():
     return GPTConfig(vocab_size=1024, hidden_size=64, num_layers=2, num_heads=4,
                      max_position=128)
+
+
+# ---------------------------------------------------------------- pipeline form
+class GPTEmbeddingPipe(Layer):
+    """Token (+ learned position) embedding as a pipeline stage-0 layer."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        c = config
+        self.config = c
+        self.embed_tokens = VocabParallelEmbedding(c.vocab_size, c.hidden_size)
+        if not c.use_rope:
+            self.embed_positions = Embedding(c.max_position, c.hidden_size)
+
+    def forward(self, input_ids):
+        x = self.embed_tokens(input_ids)
+        if not self.config.use_rope:
+            from ..ops.creation import arange
+
+            x = x + self.embed_positions(arange(input_ids.shape[1]))
+        return _shard_seq(x)
+
+
+class GPTNormPipe(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        Norm = RMSNorm if config.use_rms_norm else LayerNorm
+        self.ln_f = Norm(config.hidden_size)
+
+    def forward(self, x):
+        return self.ln_f(x)
+
+
+class GPTHeadPipe(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.lm_head = ColumnParallelLinear(
+            config.hidden_size, config.vocab_size, has_bias=False)
+
+    def forward(self, x):
+        return self.lm_head(x)
+
+
+def _tied_lm_head(embed_layer: GPTEmbeddingPipe, x):
+    return apply_op(lambda h, w: h @ w.T, "lm_head_tied", x,
+                    embed_layer.embed_tokens.weight)
+
+
+def gpt_causal_lm_loss(logits, labels):
+    logits = logits if isinstance(logits, Tensor) else Tensor(logits)
+    return F.cross_entropy(
+        logits.reshape([-1, logits.shape[-1]]), labels.reshape([-1]))
+
+
+def gpt_pipeline(config: GPTConfig, num_stages: int, loss_fn=None, **pp_kwargs):
+    """GPTForCausalLM as a PipelineLayer (BASELINE config 4: GPT-3 DP+MP+PP).
+    Tied embeddings become a SharedLayerDesc spanning the first and last stage
+    (reference pp_layers.py:77); each GPTBlock is one LayerDesc so SegmentLayers
+    can balance stages."""
+    from ..distributed.fleet.meta_parallel import (
+        LayerDesc, PipelineLayer, SharedLayerDesc,
+    )
+
+    c = config
+    blocks = [LayerDesc(GPTBlock, c) for _ in range(c.num_layers)]
+    if c.tie_embeddings:
+        descs = (
+            [SharedLayerDesc("gpt_embed", GPTEmbeddingPipe, None,
+                             "embed_tokens.weight", c)]
+            + blocks
+            + [LayerDesc(GPTNormPipe, c),
+               SharedLayerDesc("gpt_embed", GPTEmbeddingPipe, _tied_lm_head,
+                               "embed_tokens.weight", c)]
+        )
+    else:
+        descs = ([LayerDesc(GPTEmbeddingPipe, c)] + blocks
+                 + [LayerDesc(GPTNormPipe, c), LayerDesc(GPTHeadPipe, c)])
+    return PipelineLayer(descs, num_stages=num_stages,
+                         loss_fn=loss_fn or gpt_causal_lm_loss, **pp_kwargs)

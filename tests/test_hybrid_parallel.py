@@ -1,0 +1,149 @@
+"""Hybrid PP x DP x TP x ZeRO composition on the 8-device CPU mesh.
+
+Contract (VERDICT r2 item 1, the single highest-leverage item): pipeline
+stages execute over (dp, mp) SUB-MESHES — TP-sharded weights, dp-sharded
+micro-batches, ZeRO grad sharding — in ONE engine run, with loss parity
+against the plain single-device micro-batch accumulation loop."""
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import nn
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.distributed.fleet.base import HybridCommunicateGroup
+from paddle_tpu.distributed.fleet.meta_parallel import (
+    ColumnParallelLinear, LayerDesc, PipelineLayer, PipelineParallel,
+    RowParallelLinear,
+)
+
+HID = 16
+MICRO = 4
+BATCH = 8
+N_BLOCKS = 4
+
+
+class _MLPBlock(nn.Layer):
+    """Column->Row parallel pair: the canonical TP block."""
+
+    def __init__(self):
+        super().__init__()
+        self.up = ColumnParallelLinear(HID, HID * 2, gather_output=False)
+        self.down = RowParallelLinear(HID * 2, HID, input_is_parallel=True)
+
+    def forward(self, x):
+        return self.down(nn.functional.relu(self.up(x)))
+
+
+def _loss_fn(out, label):
+    return ((out - label) ** 2).mean()
+
+
+def _data(step):
+    rs = np.random.RandomState(100 + step)
+    x = paddle.to_tensor(rs.randn(BATCH, HID).astype("float32"))
+    y = paddle.to_tensor(rs.randn(BATCH, HID).astype("float32"))
+    return x, y
+
+
+def _make_model(num_stages):
+    descs = [LayerDesc(_MLPBlock) for _ in range(N_BLOCKS)]
+    return PipelineLayer(descs, num_stages=num_stages, loss_fn=_loss_fn)
+
+
+def _run_reference(steps):
+    dist.set_mesh(None)
+    paddle.seed(11)
+    model = _make_model(1)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    losses = []
+    for step in range(steps):
+        x, y = _data(step)
+        xs = paddle.split(x, MICRO, axis=0)
+        ys = paddle.split(y, MICRO, axis=0)
+        total = 0.0
+        for mx, my in zip(xs, ys):
+            loss = _loss_fn(model(mx), my)
+            (loss / MICRO).backward()
+            total += float(loss)
+        opt.step()
+        opt.clear_grad()
+        losses.append(total / MICRO)
+    return losses
+
+
+def _hybrid_strategy(pp, dp, mp, sharding=1, zero_stage=0):
+    s = DistributedStrategy()
+    s.hybrid_configs.update(
+        pp_degree=pp, dp_degree=dp, mp_degree=mp, sharding_degree=sharding)
+    s.pipeline_configs = {"accumulate_steps": MICRO,
+                          "micro_batch_size": BATCH // MICRO}
+    if zero_stage:
+        s.sharding = True
+        s.sharding_configs = {"stage": zero_stage}
+    return s
+
+
+def _run_hybrid(steps, pp, dp, mp, sharding=1, zero_stage=0):
+    strategy = _hybrid_strategy(pp, dp, mp, sharding, zero_stage)
+    hcg = HybridCommunicateGroup(strategy=strategy)
+    paddle.seed(11)
+    model = _make_model(pp)
+    wrapper = PipelineParallel(model, hcg=hcg, strategy=strategy)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    losses = []
+    for step in range(steps):
+        losses.append(float(wrapper.train_batch(_data(step), opt)))
+    dist.set_mesh(None)
+    return losses, wrapper
+
+
+@pytest.mark.parametrize("pp,dp,mp,sharding,zero", [
+    (2, 2, 2, 1, 0),   # PP x DP x TP
+    (2, 1, 2, 2, 2),   # PP x TP x ZeRO-2 over the sharding axis
+    (2, 2, 1, 2, 3),   # PP x DP x ZeRO-3
+])
+def test_hybrid_loss_parity(pp, dp, mp, sharding, zero):
+    steps = 6
+    ref = _run_reference(steps)
+    got, _ = _run_hybrid(steps, pp, dp, mp, sharding, zero)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_hybrid_stage_submesh_placement():
+    """Each stage's params live on that stage's 4-device (dp x mp) sub-mesh,
+    with TP weights actually sharded over mp."""
+    _, wrapper = _run_hybrid(1, pp=2, dp=2, mp=2)
+    engine = wrapper._engine
+    assert len(engine.execs) == 2
+    seen_devsets = []
+    for ex in engine.execs:
+        assert ex.placement.mesh is not None
+        mesh_devs = {d.id for d in ex.placement.mesh.devices.reshape(-1)}
+        assert len(mesh_devs) == 4
+        for k, t in ex.param_tensors.items():
+            tdevs = {d.id for d in t._value.devices()}
+            assert tdevs <= mesh_devs, (k, tdevs, mesh_devs)
+        seen_devsets.append(frozenset(mesh_devs))
+    assert seen_devsets[0] != seen_devsets[1]
+    # TP: a column-parallel weight is sharded (per-device shard is half the
+    # logical weight) over the stage's mp axis
+    ex0 = engine.execs[0]
+    w = next(t for k, t in ex0.param_tensors.items() if "up.weight" in k)
+    shard_shapes = {tuple(s.data.shape) for s in w._value.addressable_shards}
+    assert shard_shapes == {(HID, HID)}, shard_shapes  # [HID, 2*HID] halved on dim 1
+
+
+def test_hybrid_zero_grad_sharding():
+    """ZeRO>=2 inside a stage: the compiled backward constrains grads to the
+    sharding axis (verify via the placement's spec derivation)."""
+    _, wrapper = _run_hybrid(1, pp=2, dp=1, mp=2, sharding=2, zero_stage=2)
+    pl = wrapper._engine.execs[0].placement
+    assert pl.zero_axis == "sharding"
+    spec = pl.grad_spec((HID, HID))
+    assert spec == P("sharding", None)
+    # undivisible first dim: no constraint
+    assert pl.grad_spec((3, HID)) is None
