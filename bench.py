@@ -189,7 +189,10 @@ def main():
     dev = jax.devices()[0]
     on_accel = dev.platform not in ("cpu",)
 
-    gpt, gpt_err = bench_gpt(on_accel, dev)
+    try:
+        gpt, gpt_err = bench_gpt(on_accel, dev)
+    except Exception as e:  # a GPT-path crash must not break the one-JSON-line contract
+        gpt, gpt_err = None, {"error": repr(e)[:200]}
     try:
         resnet, resnet_err = bench_resnet(on_accel, dev)
     except Exception as e:  # resnet must not sink the GPT headline

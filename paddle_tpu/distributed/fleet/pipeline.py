@@ -314,10 +314,6 @@ class PipelineEngine:
             ex.place_params(placed)
         self._placed = placed
 
-    def invalidate_states(self):
-        for ex in self.execs:
-            ex._state_cache = None
-
     def run(self, micro_inputs, micro_labels, loss_scale=1.0):
         """One accumulation window. Returns (mean_loss, {id(param): grad})."""
         n_chunks = len(self.execs)

@@ -1,0 +1,3 @@
+from .context import Context, parse_args  # noqa: F401
+from .controller import CollectiveController  # noqa: F401
+from .main import launch, main  # noqa: F401

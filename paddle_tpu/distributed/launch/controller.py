@@ -1,0 +1,170 @@
+"""Collective controller: build the pod, spawn workers, watch, restart.
+
+Reference: python/paddle/distributed/launch/controllers/collective.py
+(CollectiveController.build_pod) + controller.py (watch loop, signal handling)
++ fleet/elastic/manager.py:125 (restart policy). The store doubles as the
+rendezvous (jax.distributed's coordinator handles the device mesh itself; the
+store carries job metadata, heartbeats, and the failure flag).
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from ..store import TCPStore
+from .context import Context
+
+
+class WorkerProc:
+    def __init__(self, local_rank, rank, proc, log_path):
+        self.local_rank = local_rank
+        self.rank = rank
+        self.proc = proc
+        self.log_path = log_path
+
+
+class CollectiveController:
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+        self.store = None
+        self.procs: list[WorkerProc] = []
+        self._restarts = 0
+        self._interrupted = False
+
+    # ------------------------------------------------------------- pod lifecycle
+    def build_pod(self):
+        ctx = self.ctx
+        os.makedirs(ctx.log_dir, exist_ok=True)
+        if self.store is None:
+            self.store = TCPStore(
+                host=ctx.master_host,
+                port=ctx.master_port,
+                world_size=ctx.world_size,
+                is_master=ctx.spawn_store,
+            )
+            if ctx.spawn_store:
+                self.store.set("job/nnodes", str(ctx.nnodes))
+                self.store.set("job/world_size", str(ctx.world_size))
+        script = ctx.args.training_script
+        script_args = list(ctx.args.training_script_args)
+        if script_args and script_args[0] == "--":
+            script_args = script_args[1:]
+        if script == "-m":
+            cmd_base = [sys.executable, "-m"] + script_args
+        elif script.endswith(".py"):
+            cmd_base = [sys.executable, "-u", script] + script_args
+        else:
+            cmd_base = [script] + script_args
+        attempt = self._restarts
+        for local_rank in range(ctx.nproc_per_node):
+            rank = ctx.rank_of(local_rank)
+            log_path = os.path.join(ctx.log_dir, f"workerlog.{local_rank}")
+            logf = open(log_path, "ab")
+            logf.write(f"---- attempt {attempt} rank {rank} ----\n".encode())
+            env = ctx.worker_env(local_rank)
+            env["PADDLE_RESTART_ATTEMPT"] = str(attempt)
+            proc = subprocess.Popen(
+                cmd_base, env=env, stdout=logf, stderr=subprocess.STDOUT,
+                start_new_session=True,
+            )
+            logf.close()
+            self.procs.append(WorkerProc(local_rank, rank, proc, log_path))
+
+    def stop_pod(self, sig=signal.SIGTERM, grace=10.0):
+        for w in self.procs:
+            if w.proc.poll() is None:
+                try:
+                    os.killpg(w.proc.pid, sig)
+                except ProcessLookupError:
+                    pass
+        deadline = time.monotonic() + grace
+        for w in self.procs:
+            timeout = max(0.1, deadline - time.monotonic())
+            try:
+                w.proc.wait(timeout)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(w.proc.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+                w.proc.wait()
+        self.procs = []
+
+    # ------------------------------------------------------------- watch loop
+    def _hung_workers(self):
+        """Heartbeat staleness check (reference comm_task_manager.cc:66 watchdog
+        role, moved to the controller: workers publish hb/<rank> timestamps)."""
+        timeout = self.ctx.args.heartbeat_timeout
+        if not timeout or self.store is None:
+            return []
+        now = time.time()
+        hung = []
+        for w in self.procs:
+            raw = self.store.get(f"hb/{w.rank}", wait=False)
+            if raw is None:
+                continue  # worker hasn't started heartbeating yet
+            try:
+                ts = float(raw.decode())
+            except ValueError:
+                continue
+            if now - ts > timeout:
+                hung.append(w)
+        return hung
+
+    def watch(self, poll_interval=0.5):
+        """Block until the pod exits. Returns the pod's exit code. On a worker
+        failure: tear down, and restart the pod if restart budget remains."""
+        while True:
+            self.build_pod()
+            code = self._watch_once(poll_interval)
+            if code == 0:
+                return 0
+            if self._interrupted or self._restarts >= self.ctx.args.max_restarts:
+                return code
+            self._restarts += 1
+            print(f"[launch] pod failed (exit {code}); restart "
+                  f"{self._restarts}/{self.ctx.args.max_restarts}", flush=True)
+            # wipe ALL store state (heartbeats, barrier counters, app keys) so
+            # the next attempt rendezvouses fresh, then restore job metadata
+            if self.store is not None:
+                self.store.clear()
+                self.store.set("job/nnodes", str(self.ctx.nnodes))
+                self.store.set("job/world_size", str(self.ctx.world_size))
+                self.store.set("job/restart_attempt", str(self._restarts))
+
+    def _watch_once(self, poll_interval):
+        try:
+            while True:
+                statuses = [w.proc.poll() for w in self.procs]
+                if all(s is not None for s in statuses):
+                    bad = [s for s in statuses if s != 0]
+                    self.procs = []
+                    return bad[0] if bad else 0
+                failed = [w for w in self.procs if w.proc.poll() not in (None, 0)]
+                hung = self._hung_workers()
+                if failed or hung:
+                    for w in failed:
+                        print(f"[launch] rank {w.rank} exited "
+                              f"{w.proc.poll()}; see {w.log_path}", flush=True)
+                    for w in hung:
+                        print(f"[launch] rank {w.rank} heartbeat stale "
+                              f"(> {self.ctx.args.heartbeat_timeout}s); killing pod",
+                              flush=True)
+                    code = failed[0].proc.poll() if failed else 124
+                    self.stop_pod()
+                    return code
+                time.sleep(poll_interval)
+        except KeyboardInterrupt:
+            # terminal: watch() must not treat the user's Ctrl-C as a worker
+            # failure and burn a restart relaunching the pod
+            self._interrupted = True
+            self.stop_pod(signal.SIGINT)
+            return 130
+
+    def finalize(self):
+        if self.store is not None:
+            self.store.close()
+            self.store = None

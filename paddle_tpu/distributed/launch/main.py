@@ -1,0 +1,37 @@
+"""``python -m paddle_tpu.distributed.launch`` — job entry point.
+
+Reference: python/paddle/distributed/launch/main.py:23 (launch(): Context →
+controller → run/watch). Example::
+
+    python -m paddle_tpu.distributed.launch --nproc_per_node 2 --backend cpu \
+        train.py --epochs 1
+
+Workers receive PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_MASTER /
+PADDLE_DISTRI_BACKEND and call ``paddle_tpu.distributed.init_parallel_env()``,
+which bootstraps jax.distributed off those variables.
+"""
+from __future__ import annotations
+
+import sys
+
+from .context import Context, parse_args
+from .controller import CollectiveController
+
+
+def launch(argv=None):
+    args = parse_args(argv)
+    ctx = Context(args)
+    controller = CollectiveController(ctx)
+    try:
+        code = controller.watch()
+    finally:
+        controller.finalize()
+    return code
+
+
+def main():
+    sys.exit(launch())
+
+
+if __name__ == "__main__":
+    main()

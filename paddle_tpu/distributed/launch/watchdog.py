@@ -1,0 +1,61 @@
+"""Worker-side control plane: heartbeat publisher + hang dump.
+
+Reference: paddle/phi/core/distributed/comm_task_manager.cc:66,137 — a watchdog
+thread that detects stuck collectives and dumps state. TPU-native shape: XLA
+owns the collectives, so the watchdog lives OUTSIDE the compiled program — each
+worker publishes ``hb/<rank>`` timestamps to the TCP store from a daemon thread
+(immune to the GIL being held by a compiled step is the server's job; the
+publisher itself runs between dispatches). The launch controller declares a
+worker hung when its heartbeat goes stale and tears down the pod. On SIGUSR1 a
+worker dumps all Python thread stacks to stderr (faulthandler), so a hang
+post-mortem is one signal away.
+"""
+from __future__ import annotations
+
+import faulthandler
+import os
+import signal
+import threading
+import time
+
+
+def install_hang_dump():
+    """Dump all thread stacks on SIGUSR1 (safe to call multiple times)."""
+    try:
+        faulthandler.register(signal.SIGUSR1, all_threads=True, chain=False)
+    except (AttributeError, ValueError):
+        pass  # non-main thread or platform without SIGUSR1
+
+
+class Heartbeat:
+    """Publishes ``hb/<rank>`` = unix-time to the store every `interval` s."""
+
+    def __init__(self, store, rank, interval=5.0):
+        self.store = store
+        self.rank = rank
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        if self._thread is not None:
+            return self
+        install_hang_dump()
+
+        def run():
+            while not self._stop.is_set():
+                try:
+                    self.store.set(f"hb/{self.rank}", str(time.time()))
+                except Exception:
+                    return  # store gone: job is tearing down
+                self._stop.wait(self.interval)
+
+        self._thread = threading.Thread(target=run, daemon=True, name="paddle-heartbeat")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None

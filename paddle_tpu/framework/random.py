@@ -22,7 +22,10 @@ class Generator:
 
     def manual_seed(self, seed: int):
         self._seed = int(seed)
-        self._key = jax.random.key(self._seed)
+        # The key materializes lazily: creating it eagerly would initialize the
+        # XLA backend at `import paddle_tpu`, which breaks
+        # jax.distributed.initialize (must run before any backend init).
+        self._key = None
         self._counter = 0
         return self
 
@@ -30,7 +33,12 @@ class Generator:
         """Return a fresh key; advances the stream. Under a TrainStep trace a traced
         base key is folded in instead of the host key, so compiled steps get fresh
         randomness per call rather than a baked-in constant."""
-        base = _trace_key if _trace_key is not None else self._key
+        if _trace_key is not None:
+            base = _trace_key
+        else:
+            if self._key is None:
+                self._key = jax.random.key(self._seed)
+            base = self._key
         k = jax.random.fold_in(base, self._counter)
         self._counter += 1
         return k
@@ -40,7 +48,7 @@ class Generator:
 
     def set_state(self, state):
         self._seed, self._counter = state
-        self._key = jax.random.key(self._seed)
+        self._key = None
         return self
 
 

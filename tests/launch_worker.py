@@ -1,0 +1,51 @@
+"""Worker script for tests/test_launch.py — run under
+``python -m paddle_tpu.distributed.launch --backend cpu --nproc_per_node 2``.
+
+Does a genuine cross-process collective (global sum over a 2-device CPU mesh,
+one device per process) and reports the result through the control-plane store.
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu.distributed as dist
+
+
+def main():
+    dist.init_parallel_env()
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    rank, world = dist.get_rank(), dist.get_world_size()
+    assert world == int(os.environ["PADDLE_TRAINERS_NUM"]), (world, os.environ)
+
+    if "--fail-once" in sys.argv and rank == 1:
+        # elastic-restart test: die on the first attempt only. Hard exit —
+        # a graceful sys.exit would block ~30s in jax's atexit coordination
+        # shutdown (rank 0 is inside a collective), masking the crash we are
+        # simulating.
+        if int(os.environ.get("PADDLE_RESTART_ATTEMPT", "0")) == 0:
+            os._exit(17)
+
+    mesh = Mesh(np.array(jax.devices()), ("x",))
+    local = jnp.ones((1, 4)) * (rank + 1)
+    garr = jax.make_array_from_single_device_arrays(
+        (world, 4), NamedSharding(mesh, P("x")), [local])
+    total = jax.jit(lambda a: jnp.sum(a, axis=0),
+                    out_shardings=NamedSharding(mesh, P()))(garr)
+    result = np.asarray(jax.device_get(total))
+    expected = world * (world + 1) / 2
+    assert np.allclose(result, expected), (result, expected)
+
+    from paddle_tpu.distributed.env import _store
+    assert _store is not None, "control-plane store not connected"
+    _store.set(f"result/{rank}", ",".join(str(float(v)) for v in result))
+    _store.barrier("done", world, timeout=60)
+
+
+if __name__ == "__main__":
+    main()

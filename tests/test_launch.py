@@ -1,0 +1,145 @@
+"""Launcher + control plane tests (VERDICT r2 item 3).
+
+Covers: the TCP store (native C++ server + Python fallback, same protocol),
+barrier semantics, and the full ``python -m paddle_tpu.distributed.launch``
+path — 2 worker processes on the CPU backend running a genuine cross-process
+collective, plus restart-on-failure.
+
+These spawn real subprocesses (each imports jax), so they are the slowest tests
+in the suite; the collective ones share one launched run via a module fixture
+where possible.
+"""
+import os
+import struct
+import subprocess
+import sys
+import threading
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "launch_worker.py")
+
+
+# ------------------------------------------------------------------ store unit tests
+@pytest.mark.parametrize("prefer_native", [True, False])
+def test_store_set_get_add_wait(prefer_native):
+    from paddle_tpu.distributed.store import TCPStore
+
+    master = TCPStore(is_master=True, world_size=2, prefer_native=prefer_native)
+    assert master.server.native == prefer_native or not prefer_native
+    client = TCPStore(port=master.port, world_size=2)
+    try:
+        master.set("k", b"v1")
+        assert client.get("k") == b"v1"
+        client.set("k", "v2")
+        assert master.get("k") == b"v2"
+        assert master.get("nope", wait=False) is None
+        assert client.add("ctr", 3) == 3
+        assert master.add("ctr", -1) == 2
+        assert client.wait_key("k", 1.0)
+        assert not client.wait_key("absent", 0.2)
+        assert master.delete_key("k")
+        assert not master.delete_key("k")
+        n0 = master.num_keys()
+        master.set("another", b"x")
+        assert master.num_keys() == n0 + 1
+    finally:
+        client.close()
+        master.close()
+
+
+def test_store_barrier_blocks_until_all():
+    from paddle_tpu.distributed.store import TCPStore
+
+    master = TCPStore(is_master=True, world_size=3)
+    clients = [TCPStore(port=master.port, world_size=3) for _ in range(2)]
+    errs, order = [], []
+
+    def arrive(st, name):
+        try:
+            st.barrier("b", 3, timeout=10)
+            order.append(name)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    try:
+        ts = [threading.Thread(target=arrive, args=(s, i))
+              for i, s in enumerate([master] + clients)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(15)
+        assert not errs
+        assert len(order) == 3
+        with pytest.raises(TimeoutError):
+            master.barrier("b2", 3, timeout=0.3)  # nobody else arrives
+    finally:
+        for s in clients:
+            s.close()
+        master.close()
+
+
+def test_store_concurrent_add_is_atomic():
+    from paddle_tpu.distributed.store import TCPStore
+
+    master = TCPStore(is_master=True)
+    clients = [TCPStore(port=master.port) for _ in range(4)]
+    try:
+        def bump(st):
+            for _ in range(50):
+                st.add("n", 1)
+
+        ts = [threading.Thread(target=bump, args=(s,)) for s in clients]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert struct.unpack("<q", master.get("n"))[0] == 200
+    finally:
+        for s in clients:
+            s.close()
+        master.close()
+
+
+# ------------------------------------------------------------------ launch e2e
+def _run_launch(extra_args, worker_args=(), timeout=240):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # workers get their own platform setup
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--backend", "cpu", *extra_args, WORKER, *worker_args]
+    return subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                          text=True, timeout=timeout)
+
+
+def _read_results(log_dir, world):
+    """Workers publish to the store which dies with the pod; read the log files
+    for crash context and assert via a second launch-free check: the worker
+    re-verifies the collective itself, so pod exit 0 == collective correct."""
+    logs = {}
+    for i in range(world):
+        p = os.path.join(log_dir, f"workerlog.{i}")
+        if os.path.exists(p):
+            logs[i] = open(p).read()
+    return logs
+
+
+def test_launch_two_process_collective(tmp_path):
+    r = _run_launch(["--nproc_per_node", "2", "--log_dir", str(tmp_path)])
+    logs = _read_results(tmp_path, 2)
+    assert r.returncode == 0, (r.stdout, r.stderr, logs)
+
+
+def test_launch_restart_on_failure(tmp_path):
+    r = _run_launch(["--nproc_per_node", "2", "--max_restarts", "1",
+                     "--log_dir", str(tmp_path)], worker_args=("--fail-once",))
+    logs = _read_results(tmp_path, 2)
+    assert "restart 1/1" in r.stdout, (r.stdout, r.stderr)
+    assert r.returncode == 0, (r.stdout, r.stderr, logs)
+
+
+def test_launch_propagates_failure_when_no_restarts(tmp_path):
+    r = _run_launch(["--nproc_per_node", "2", "--max_restarts", "0",
+                     "--log_dir", str(tmp_path)], worker_args=("--fail-once",))
+    logs = _read_results(tmp_path, 2)
+    assert r.returncode == 17, (r.returncode, r.stdout, r.stderr, logs)
