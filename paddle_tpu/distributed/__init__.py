@@ -24,6 +24,8 @@ from .context_parallel import (  # noqa: F401
     RingFlashAttention, SegmentParallel, ring_attention, ulysses_attention,
 )
 from . import fleet  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
 
 # aliases used in reference code
 all_to_all = alltoall

@@ -49,7 +49,10 @@ def parse_args(argv=None):
                         "heartbeat (0 = disabled)")
     p.add_argument("--run_mode", default="collective", choices=["collective"],
                    help="job mode (only collective is supported)")
-    p.add_argument("training_script", help="script (or -m module) to run")
+    p.add_argument("-m", "--module", action="store_true",
+                   help="treat training_script as a module path "
+                        "(python -m style) instead of a file")
+    p.add_argument("training_script", help="script file or (with -m) module to run")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
 

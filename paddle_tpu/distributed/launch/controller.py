@@ -33,6 +33,7 @@ class CollectiveController:
         self.procs: list[WorkerProc] = []
         self._restarts = 0
         self._interrupted = False
+        self._remote_restart = False
 
     # ------------------------------------------------------------- pod lifecycle
     def build_pod(self):
@@ -52,8 +53,8 @@ class CollectiveController:
         script_args = list(ctx.args.training_script_args)
         if script_args and script_args[0] == "--":
             script_args = script_args[1:]
-        if script == "-m":
-            cmd_base = [sys.executable, "-m"] + script_args
+        if getattr(ctx.args, "module", False):
+            cmd_base = [sys.executable, "-u", "-m", script] + script_args
         elif script.endswith(".py"):
             cmd_base = [sys.executable, "-u", script] + script_args
         else:
@@ -116,28 +117,81 @@ class CollectiveController:
 
     def watch(self, poll_interval=0.5):
         """Block until the pod exits. Returns the pod's exit code. On a worker
-        failure: tear down, and restart the pod if restart budget remains."""
+        failure: tear down, and restart the pod if restart budget remains.
+
+        Multi-node: restarts must be JOB-wide, not per-node. The failing node
+        publishes ``__launch/restart_req/<n>``; every controller's poll loop sees
+        it, tears down its local pod, and joins the restart rendezvous
+        (ready/<n>/<node> keys → node 0 wipes the store → go/<n>). A node that
+        gives up publishes ``__launch/abort`` so the others exit too."""
         while True:
             self.build_pod()
+            remote = self._remote_restart = False
             code = self._watch_once(poll_interval)
+            remote = self._remote_restart
             if code == 0:
                 return 0
-            if self._interrupted or self._restarts >= self.ctx.args.max_restarts:
+            if self._interrupted or (not remote and
+                                     self._restarts >= self.ctx.args.max_restarts):
+                if self.ctx.nnodes > 1 and self.store is not None:
+                    self.store.set("__launch/abort", str(code))
                 return code
             self._restarts += 1
+            n = self._restarts
             print(f"[launch] pod failed (exit {code}); restart "
-                  f"{self._restarts}/{self.ctx.args.max_restarts}", flush=True)
-            # wipe ALL store state (heartbeats, barrier counters, app keys) so
-            # the next attempt rendezvouses fresh, then restore job metadata
+                  f"{n}/{self.ctx.args.max_restarts}", flush=True)
             if self.store is not None:
-                self.store.clear()
-                self.store.set("job/nnodes", str(self.ctx.nnodes))
-                self.store.set("job/world_size", str(self.ctx.world_size))
-                self.store.set("job/restart_attempt", str(self._restarts))
+                if self.ctx.nnodes > 1:
+                    if not remote:
+                        self.store.set(f"__launch/restart_req/{n}", str(code))
+                    self.store.set(f"__launch/ready/{n}/{self.ctx.node_rank}", b"1")
+                    if self.ctx.node_rank == 0:
+                        for r in range(self.ctx.nnodes):
+                            self.store.wait([f"__launch/ready/{n}/{r}"])
+                        self._reset_store()
+                        self.store.set(f"__launch/go/{n}", b"1")
+                    else:
+                        self.store.wait([f"__launch/go/{n}"])
+                else:
+                    self._reset_store()
+
+    def _reset_store(self):
+        """Wipe ALL rendezvous state (heartbeats, barrier counters, app keys)
+        so the next attempt starts fresh, then restore job metadata."""
+        self.store.clear()
+        self.store.set("job/nnodes", str(self.ctx.nnodes))
+        self.store.set("job/world_size", str(self.ctx.world_size))
+        self.store.set("job/restart_attempt", str(self._restarts))
+
+    def _check_remote_signals(self):
+        """Another node may have requested a job-wide restart or abort."""
+        if self.ctx.nnodes <= 1 or self.store is None:
+            return None
+        raw = self.store.get("__launch/abort", wait=False)
+        if raw is not None:
+            self._interrupted = True  # terminal: do not restart
+            try:
+                return int(raw.decode()) or 1
+            except ValueError:
+                return 1
+        raw = self.store.get(f"__launch/restart_req/{self._restarts + 1}", wait=False)
+        if raw is not None:
+            self._remote_restart = True
+            try:
+                return int(raw.decode()) or 1
+            except ValueError:
+                return 1
+        return None
 
     def _watch_once(self, poll_interval):
         try:
             while True:
+                remote_code = self._check_remote_signals()
+                if remote_code is not None:
+                    print(f"[launch] remote node signalled "
+                          f"{'abort' if self._interrupted else 'restart'}", flush=True)
+                    self.stop_pod()
+                    return remote_code
                 statuses = [w.proc.poll() for w in self.procs]
                 if all(s is not None for s in statuses):
                     bad = [s for s in statuses if s != 0]

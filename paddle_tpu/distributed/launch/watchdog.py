@@ -43,11 +43,18 @@ class Heartbeat:
         install_hang_dump()
 
         def run():
+            misses = 0
             while not self._stop.is_set():
                 try:
                     self.store.set(f"hb/{self.rank}", str(time.time()))
+                    misses = 0
                 except Exception:
-                    return  # store gone: job is tearing down
+                    # a transient store hiccup must not silence the heartbeat
+                    # for good (the watchdog would kill a healthy pod); only
+                    # give up after repeated consecutive failures
+                    misses += 1
+                    if misses >= 5:
+                        return
                 self._stop.wait(self.interval)
 
         self._thread = threading.Thread(target=run, daemon=True, name="paddle-heartbeat")

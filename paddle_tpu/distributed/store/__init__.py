@@ -92,7 +92,9 @@ class _PyHandler(socketserver.BaseRequestHandler):
                     key = self._read_lv()
                     (delta,) = struct.unpack("<q", self._read(8))
                     with srv.cond:
-                        cur = struct.unpack("<q", srv.data[key])[0] if key in srv.data else 0
+                        prev = srv.data.get(key)
+                        # non-8-byte values count as 0, matching the native server
+                        cur = struct.unpack("<q", prev)[0] if prev is not None and len(prev) == 8 else 0
                         new = cur + delta
                         srv.data[key] = struct.pack("<q", new)
                         srv.cond.notify_all()
@@ -238,11 +240,7 @@ class TCPStore:
             self._sock.sendall(bytes([_CMD_SET]) + self._lv(k) + self._lv(v))
             assert self._read(1) == b"\x01"
 
-    def get(self, key, wait=True, timeout=None):
-        """Blocking get (paddle semantics: get waits for the key)."""
-        if wait:
-            if not self.wait_key(key, timeout if timeout is not None else self._timeout):
-                raise TimeoutError(f"store key {key!r} never appeared")
+    def _get_once(self, key):
         k = self._enc(key)
         with self._lock:
             self._sock.sendall(bytes([_CMD_GET]) + self._lv(k))
@@ -250,6 +248,22 @@ class TCPStore:
                 return None
             (n,) = struct.unpack("<I", self._read(4))
             return self._read(n) if n else b""
+
+    def get(self, key, wait=True, timeout=None):
+        """Blocking get (paddle semantics: get waits for the key). WAIT and GET
+        are separate RPCs, so a concurrent delete can sneak between them — loop
+        until the value is actually in hand or the deadline passes."""
+        if not wait:
+            return self._get_once(key)
+        t = timeout if timeout is not None else self._timeout
+        deadline = time.monotonic() + t
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 or not self.wait_key(key, remaining):
+                raise TimeoutError(f"store key {key!r} never appeared")
+            val = self._get_once(key)
+            if val is not None:
+                return val
 
     def add(self, key, delta=1):
         k = self._enc(key)

@@ -1,0 +1,14 @@
+"""paddle.utils surface. Reference: python/paddle/utils/__init__.py."""
+from . import unique_name  # noqa: F401
+
+
+def try_import(module_name):
+    """Reference: utils/lazy_import.py — import or raise a friendly error."""
+    import importlib
+
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            f"{module_name} is required but not installed in this environment"
+        ) from e

@@ -1,0 +1,51 @@
+"""Unique-name generator with scoped guards.
+
+Reference: python/paddle/utils/unique_name.py (generate/guard/switch over a
+UniqueNameGenerator). Names here back Tensor.name / optimizer accumulator keys,
+so `guard()` gives reproducible names when re-instantiating a model in one
+process (e.g. checkpoint resume tests, program re-tracing).
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..tensor import Tensor
+
+
+class NameGenerator:
+    def __init__(self):
+        self.ids: dict[str, int] = {}
+
+    def generate(self, key: str = "tmp") -> str:
+        n = self.ids.get(key, 0)
+        self.ids[key] = n + 1
+        return f"{key}_{n}"
+
+
+_generator = NameGenerator()
+
+
+def generate(key: str = "tmp") -> str:
+    return _generator.generate(key)
+
+
+def switch(new_generator=None):
+    """Swap the active generator AND the Tensor id counter; returns the old pair."""
+    global _generator
+    old = (_generator, Tensor._iid)
+    _generator = new_generator if new_generator is not None else NameGenerator()
+    Tensor._iid = 0
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    """Fresh (or given) name scope inside the `with`; restores the outer scope —
+    including the Tensor auto-name counter — on exit."""
+    old_gen, old_iid = switch(new_generator)
+    try:
+        yield
+    finally:
+        global _generator
+        _generator = old_gen
+        Tensor._iid = old_iid

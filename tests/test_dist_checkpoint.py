@@ -1,0 +1,199 @@
+"""Distributed checkpoint tests (VERDICT r2 item 4).
+
+Acceptance bar from the verdict: train on (dp=4,mp=2), save, restore on
+(dp=2,mp=4), losses continue identically; works with ZeRO-3-sharded state.
+Runs on the 8-device virtual CPU mesh from conftest.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import nn
+from paddle_tpu.distributed.checkpoint import load_state_dict, save_state_dict
+
+
+def _sharded_params(mesh_shape, dim_names, placements_by_name, arrays):
+    mesh = dist.ProcessMesh(
+        np.arange(8).reshape(mesh_shape).tolist(), dim_names=dim_names)
+    out = {}
+    for name, arr in arrays.items():
+        t = paddle.to_tensor(arr)
+        out[name] = dist.shard_tensor(t, mesh, placements_by_name[name])
+    return out
+
+
+def test_save_then_reshard_load_roundtrip(tmp_path):
+    """Save sharded on a (4,2) dp×mp mesh, restore onto (2,4) — values identical."""
+    rng = np.random.default_rng(0)
+    arrays = {
+        "w1": rng.standard_normal((16, 8)).astype("float32"),
+        "w2": rng.standard_normal((8, 24)).astype("float32"),
+        "b": rng.standard_normal((24,)).astype("float32"),
+    }
+    placements_a = {
+        "w1": [dist.Shard(0), dist.Shard(1)],   # dp shards rows, mp shards cols
+        "w2": [dist.Replicate(), dist.Shard(1)],
+        "b": [dist.Replicate(), dist.Replicate()],
+    }
+    sd_a = _sharded_params((4, 2), ["dp", "mp"], placements_a, arrays)
+    save_state_dict(sd_a, str(tmp_path / "ckpt"))
+
+    placements_b = {
+        "w1": [dist.Shard(1), dist.Shard(0)],   # transposed axis mapping
+        "w2": [dist.Shard(1), dist.Replicate()],
+        "b": [dist.Shard(0), dist.Replicate()],
+    }
+    fresh = {k: np.zeros_like(v) for k, v in arrays.items()}
+    sd_b = _sharded_params((2, 4), ["dp", "mp"], placements_b, fresh)
+    load_state_dict(sd_b, str(tmp_path / "ckpt"))
+    for name, arr in arrays.items():
+        got = np.asarray(sd_b[name]._value)
+        np.testing.assert_allclose(got, arr, rtol=0, atol=0, err_msg=name)
+        # and the sharding of the target survived the load
+        assert sd_b[name]._value.sharding.is_equivalent_to(
+            dist.shard_tensor(paddle.to_tensor(arr),
+                              dist.ProcessMesh(np.arange(8).reshape(2, 4).tolist(),
+                                               dim_names=["dp", "mp"]),
+                              placements_b[name])._value.sharding, len(arr.shape))
+
+
+def test_nested_dict_and_scalars(tmp_path):
+    sd = {
+        "model": {"w": paddle.to_tensor(np.arange(12, dtype="float32").reshape(3, 4))},
+        "opt": {"lr": 0.125, "step": 7, "name": "adam"},
+    }
+    save_state_dict(sd, str(tmp_path / "c"))
+    target = {
+        "model": {"w": paddle.to_tensor(np.zeros((3, 4), "float32"))},
+        "opt": {"lr": 0.0, "step": 0, "name": ""},
+    }
+    load_state_dict(target, str(tmp_path / "c"))
+    np.testing.assert_array_equal(np.asarray(target["model"]["w"]._value),
+                                  np.arange(12, dtype="float32").reshape(3, 4))
+    assert target["opt"] == {"lr": 0.125, "step": 7, "name": "adam"}
+
+
+def test_missing_key_raises(tmp_path):
+    sd = {"w": paddle.to_tensor(np.ones((2, 2), "float32"))}
+    save_state_dict(sd, str(tmp_path / "c"))
+    with pytest.raises(KeyError):
+        load_state_dict({"nope": paddle.to_tensor(np.ones((2, 2), "float32"))},
+                        str(tmp_path / "c"))
+
+
+def test_async_save(tmp_path):
+    sd = {"w": paddle.to_tensor(np.full((4, 4), 3.0, "float32"))}
+    handle = save_state_dict(sd, str(tmp_path / "c"), async_save=True)
+    handle.result(timeout=30)
+    target = {"w": paddle.to_tensor(np.zeros((4, 4), "float32"))}
+    load_state_dict(target, str(tmp_path / "c"))
+    assert float(np.asarray(target["w"]._value)[0, 0]) == 3.0
+
+
+def _train_steps(model, opt, xs, ys, n):
+    import paddle_tpu.nn.functional as F
+
+    losses = []
+    for i in range(n):
+        loss = F.cross_entropy(model(xs[i]), ys[i])
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    return losses
+
+
+def test_training_resume_across_mesh_change(tmp_path):
+    """The verdict's acceptance test: train, save (mesh A), restore (mesh B),
+    continued losses match an uninterrupted run exactly."""
+    rng = np.random.default_rng(1)
+    xs = [paddle.to_tensor(rng.standard_normal((8, 16)).astype("float32"))
+          for _ in range(6)]
+    ys = [paddle.to_tensor(rng.integers(0, 4, (8,))) for _ in range(6)]
+
+    def make():
+        # unique_name.guard: fresh model instances get identical param names, so
+        # optimizer accumulator keys line up across save/restore in one process
+        with paddle.utils.unique_name.guard():
+            paddle.seed(42)
+            m = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+            o = paddle.optimizer.Adam(learning_rate=1e-2, parameters=m.parameters())
+        return m, o
+
+    # uninterrupted reference run
+    m_ref, o_ref = make()
+    ref_losses = _train_steps(m_ref, o_ref, xs, ys, 6)
+
+    # run A: 3 steps, shard params over mesh A, save
+    m_a, o_a = make()
+    _train_steps(m_a, o_a, xs, ys, 3)
+    mesh_a = dist.ProcessMesh(np.arange(8).reshape(4, 2).tolist(), dim_names=["dp", "mp"])
+    for _, p in m_a.named_parameters():
+        if p.ndim == 2:
+            dist.shard_tensor(p, mesh_a, [dist.Replicate(), dist.Shard(1)])
+    save_state_dict({"model": m_a.state_dict(), "opt": o_a.state_dict()},
+                    str(tmp_path / "resume"))
+
+    # run B: fresh everything on mesh B, restore, continue 3 steps
+    m_b, o_b = make()
+    _train_steps(m_b, o_b, xs, ys, 1)  # desync state to prove restore overwrites it
+    mesh_b = dist.ProcessMesh(np.arange(8).reshape(2, 4).tolist(), dim_names=["dp", "mp"])
+    for _, p in m_b.named_parameters():
+        if p.ndim == 2:
+            dist.shard_tensor(p, mesh_b, [dist.Replicate(), dist.Shard(0)])
+    target = {"model": m_b.state_dict(), "opt": o_b.state_dict()}
+    load_state_dict(target, str(tmp_path / "resume"))
+    m_b.set_state_dict(target["model"])
+    o_b.set_state_dict(target["opt"])
+    cont_losses = _train_steps(m_b, o_b, xs[3:], ys[3:], 3)
+    np.testing.assert_allclose(cont_losses, ref_losses[3:], rtol=1e-5,
+                               err_msg=f"{cont_losses} vs {ref_losses[3:]}")
+
+
+def test_zero3_state_save_load(tmp_path):
+    """ZeRO-3-sharded training state round-trips through the checkpoint."""
+    from paddle_tpu.jit.train import TrainStep
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.default_rng(2)
+    xs = [paddle.to_tensor(rng.standard_normal((8, 16)).astype("float32"))
+          for _ in range(4)]
+    ys = [paddle.to_tensor(rng.integers(0, 4, (8,))) for _ in range(4)]
+
+    mesh = dist.auto_mesh(8, dim_names=["dp"])
+    prev = dist.get_mesh()
+    dist.set_mesh(mesh)
+
+    def make_step():
+        with paddle.utils.unique_name.guard():
+            paddle.seed(7)
+            m = nn.Sequential(nn.Linear(16, 64), nn.ReLU(), nn.Linear(64, 4))
+            o = paddle.optimizer.Adam(learning_rate=1e-2, parameters=m.parameters())
+        o = dist.shard_optimizer(o, dist.ShardingStage3("dp", mesh))
+        step = TrainStep(m, lambda out, y: F.cross_entropy(out, y), o)
+        return m, o, step
+
+    m1, o1, step1 = make_step()
+    l1 = [float(step1(x, y).numpy()) for x, y in zip(xs[:2], ys[:2])]
+    save_state_dict({"model": m1.state_dict(), "opt": o1.state_dict()},
+                    str(tmp_path / "z3"))
+
+    m2, o2, step2 = make_step()
+    target = {"model": m2.state_dict(), "opt": o2.state_dict()}
+    # accumulators exist only after a step: prime then restore
+    _ = step2(xs[0], ys[0])
+    target = {"model": m2.state_dict(), "opt": o2.state_dict()}
+    load_state_dict(target, str(tmp_path / "z3"))
+    m2.set_state_dict(target["model"])
+    o2.set_state_dict(target["opt"])
+    l2 = [float(step2(x, y).numpy()) for x, y in zip(xs[2:], ys[2:])]
+
+    # reference: uninterrupted
+    try:
+        m3, o3, step3 = make_step()
+        ref = [float(step3(x, y).numpy()) for x, y in zip(xs, ys)]
+        np.testing.assert_allclose(l1, ref[:2], rtol=1e-5)
+        np.testing.assert_allclose(l2, ref[2:], rtol=1e-4, err_msg=f"{l2} vs {ref[2:]}")
+    finally:
+        dist.set_mesh(prev)
