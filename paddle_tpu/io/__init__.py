@@ -310,8 +310,16 @@ class DataLoader:
                 yield self.collate_fn([self.dataset[i] for i in idxs])
 
     def __iter__(self):
+        # feed the profiler's throughput timer: time spent here (waiting on
+        # data) is the step's reader_cost (reference timer.py reader hooks)
+        from ..profiler.timer import benchmark
+
+        bm = benchmark()
         if self.num_workers == 0:
-            yield from self._iter_direct()
+            for batch in self._iter_direct():
+                bm.after_reader()
+                yield batch
+                bm.before_reader()
             return
         # threaded prefetch pipeline (host-side IO overlap with device compute)
         q: queue.Queue = queue.Queue(maxsize=self.num_workers * self.prefetch_factor)
@@ -330,5 +338,7 @@ class DataLoader:
             item = q.get()
             if item is sentinel:
                 break
+            bm.after_reader()
             yield item
+            bm.before_reader()
         t.join()

@@ -1,0 +1,24 @@
+"""paddle.profiler surface.
+
+Reference: python/paddle/profiler/__init__.py — Profiler, ProfilerState,
+ProfilerTarget, make_scheduler, export_chrome_tracing, RecordEvent,
+load_profiler_result, benchmark.
+"""
+from .profiler import (  # noqa: F401
+    Profiler,
+    ProfilerState,
+    ProfilerTarget,
+    RecordEvent,
+    TracerEventType,
+    export_chrome_tracing,
+    make_scheduler,
+)
+from .timer import Benchmark, benchmark  # noqa: F401
+
+import json as _json
+
+
+def load_profiler_result(filename: str):
+    """Load an exported chrome-trace json back as a list of event dicts."""
+    with open(filename) as f:
+        return _json.load(f)["traceEvents"]

@@ -1,0 +1,114 @@
+"""Throughput timer: reader cost / batch cost / IPS.
+
+Reference: python/paddle/profiler/timer.py (Benchmark with Event records,
+reader/batch averages, speed summary; hooked from DataLoader and
+Profiler.step). Exponential reset windows from the reference are simplified to
+running windows with explicit reset().
+"""
+from __future__ import annotations
+
+import time
+
+
+class _Avg:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.total = 0.0
+        self.count = 0
+        self.samples = 0
+
+    def record(self, cost, samples=None):
+        self.total += cost
+        self.count += 1
+        if samples:
+            self.samples += samples
+
+    @property
+    def average(self):
+        return self.total / self.count if self.count else 0.0
+
+    def speed(self):
+        """items/sec: samples if recorded, else steps."""
+        if self.total <= 0:
+            return 0.0
+        num = self.samples if self.samples else self.count
+        return num / self.total
+
+
+class Benchmark:
+    """Step timing harness. reader cost = time spent waiting on data."""
+
+    def __init__(self):
+        self.reader = _Avg()
+        self.batch = _Avg()
+        self._step_start = None
+        self._reader_start = None
+        self._running = False
+        self.current_event = self  # reference API shape (benchmark().current_event)
+
+    # ---------------------------------------------------------------- lifecycle
+    def begin(self):
+        self._running = True
+        self._step_start = time.perf_counter()
+        self._reader_start = self._step_start
+
+    def step(self, num_samples=None):
+        if not self._running:
+            return
+        now = time.perf_counter()
+        self.batch.record(now - self._step_start, num_samples)
+        self._step_start = now
+        self._reader_start = now
+
+    def end(self):
+        self._running = False
+
+    def reset(self):
+        self.reader.reset()
+        self.batch.reset()
+
+    # ---------------------------------------------------------------- reader hooks
+    def before_reader(self):
+        self._reader_start = time.perf_counter()
+
+    def after_reader(self):
+        if self._running and self._reader_start is not None:
+            self.reader.record(time.perf_counter() - self._reader_start)
+
+    # ---------------------------------------------------------------- results
+    @property
+    def reader_average(self):
+        return self.reader.average
+
+    @property
+    def batch_average(self):
+        return self.batch.average
+
+    @property
+    def ips(self):
+        return self.batch.speed()
+
+    speed_average = ips
+
+    def get_summary(self):
+        return {
+            "reader_cost": self.reader_average,
+            "batch_cost": self.batch_average,
+            "ips": self.ips,
+            "steps": self.batch.count,
+        }
+
+    def step_info(self, unit="samples"):
+        s = self.get_summary()
+        return (f"reader_cost: {s['reader_cost']:.5f} s, batch_cost: "
+                f"{s['batch_cost']:.5f} s, ips: {s['ips']:.3f} {unit}/s")
+
+
+_benchmark = Benchmark()
+
+
+def benchmark() -> Benchmark:
+    """Reference timer.py:benchmark() — the global Benchmark singleton."""
+    return _benchmark

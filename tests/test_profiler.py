@@ -1,0 +1,123 @@
+"""Profiler tests (VERDICT r2 item 4 / missing #4): scheduler states,
+RecordEvent collection, chrome-trace export, summary aggregation, IPS timer."""
+import json
+import os
+import time
+
+import pytest
+
+from paddle_tpu import profiler
+from paddle_tpu.profiler import (
+    Profiler, ProfilerState, RecordEvent, export_chrome_tracing, make_scheduler,
+)
+
+
+def test_make_scheduler_state_sequence():
+    sched = make_scheduler(closed=1, ready=1, record=2, repeat=2, skip_first=1)
+    states = [sched(i) for i in range(10)]
+    S = ProfilerState
+    assert states == [
+        S.CLOSED,                      # skip_first
+        S.CLOSED, S.READY, S.RECORD, S.RECORD_AND_RETURN,   # cycle 1
+        S.CLOSED, S.READY, S.RECORD, S.RECORD_AND_RETURN,   # cycle 2
+        S.CLOSED,                      # repeat exhausted
+    ]
+
+
+def test_make_scheduler_validates():
+    with pytest.raises(ValueError):
+        make_scheduler(closed=0, ready=0, record=0)
+
+
+def test_record_event_requires_recording_profiler():
+    ev_name = "outside_any_profiler"
+    with RecordEvent(ev_name):
+        pass
+    p = Profiler(scheduler=lambda s: ProfilerState.RECORD)
+    p.start()
+    with RecordEvent("inside"):
+        time.sleep(0.002)
+    p.stop()
+    names = [e.name for e in p.events]
+    assert "inside" in names
+    assert ev_name not in names
+
+
+def test_profiler_tuple_scheduler_and_chrome_export(tmp_path):
+    handler = export_chrome_tracing(str(tmp_path))
+    p = Profiler(scheduler=(1, 3), on_trace_ready=handler)
+    p.start()
+    for i in range(5):
+        with RecordEvent(f"step_work_{i}"):
+            time.sleep(0.001)
+        p.step()
+    p.stop()
+    # steps 1 and 2 recorded; step 0, 3, 4 not
+    names = [e.name for e in p.events]
+    assert any("step_work_1" == n for n in names)
+    assert any("step_work_2" == n for n in names)
+    assert not any("step_work_0" == n for n in names)
+    assert not any("step_work_4" == n for n in names)
+    assert p.last_export_path and os.path.exists(p.last_export_path)
+    trace = json.load(open(p.last_export_path))["traceEvents"]
+    assert all({"name", "ph", "ts", "dur"} <= set(t) for t in trace)
+    loaded = profiler.load_profiler_result(p.last_export_path)
+    assert len(loaded) == len(trace)
+
+
+def test_record_event_as_decorator():
+    p = Profiler()
+    p.start()
+
+    @RecordEvent("decorated_fn")
+    def work():
+        time.sleep(0.001)
+        return 42
+
+    assert work() == 42
+    p.stop()
+    assert "decorated_fn" in [e.name for e in p.events]
+
+
+def test_profile_step_markers_and_summary(capsys):
+    p = Profiler()
+    p.start()
+    for _ in range(3):
+        with RecordEvent("matmul"):
+            time.sleep(0.001)
+        p.step()
+    p.stop()
+    rows = p.summary()
+    by_name = {r[0]: r for r in rows}
+    assert by_name["matmul"][1] == 3           # 3 calls
+    assert by_name["matmul"][2] >= 3 * 0.9     # >= ~3ms total (ms units)
+    assert any(n.startswith("ProfileStep#") for n in by_name)
+    assert "Name" in capsys.readouterr().out
+
+
+def test_benchmark_ips():
+    b = profiler.Benchmark()
+    b.begin()
+    for _ in range(4):
+        b.before_reader()
+        time.sleep(0.001)
+        b.after_reader()
+        time.sleep(0.003)
+        b.step(num_samples=32)
+    b.end()
+    s = b.get_summary()
+    assert s["steps"] == 4
+    assert s["reader_cost"] >= 0.0005
+    assert s["batch_cost"] >= 0.003
+    assert s["ips"] == pytest.approx(32 * 4 / b.batch.total, rel=1e-6)
+    assert "ips" in b.step_info()
+
+
+def test_timer_only_mode_records_no_events():
+    p = Profiler(timer_only=True)
+    p.start()
+    with RecordEvent("should_not_appear"):
+        pass
+    p.step(num_samples=16)
+    p.stop()
+    assert p.events == []
