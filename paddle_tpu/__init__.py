@@ -50,6 +50,8 @@ from . import incubate  # noqa: E402
 from . import distribution  # noqa: E402
 from . import utils  # noqa: E402
 from . import profiler  # noqa: E402
+from . import static  # noqa: E402
+from . import inference  # noqa: E402
 from .framework.io_utils import save, load  # noqa: E402,F401
 from .hapi.model import Model  # noqa: E402,F401
 from .nn.layer import ParamAttr  # noqa: E402,F401

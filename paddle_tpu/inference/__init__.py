@@ -1,0 +1,119 @@
+"""paddle.inference: load-and-serve without the model class.
+
+Reference: paddle/fluid/inference/api/analysis_predictor.cc (AnalysisPredictor)
++ python/paddle/inference/wrapper.py (Config, create_predictor, input/output
+handles). TPU-native shape: the "analysis" passes are XLA's job; the predictor
+wraps a deserialized jax.export program (saved by ``paddle.jit.save`` with
+input_spec), compiles per concrete input signature, and keeps weights resident
+on device across ``run()`` calls.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class Config:
+    """Reference: inference Config — model path + execution knobs."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        # paddle convention: Config("path/model") with side files derived
+        self._model_path = prog_file
+        self._batch_poly = True
+        self._device = None  # None = jax default (TPU when present)
+        self._memory_optim = True
+
+    def set_model(self, path):
+        self._model_path = path
+
+    def model_path(self):
+        return self._model_path
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_memory_optim(self, flag=True):
+        self._memory_optim = flag
+
+    def switch_use_feed_fetch_ops(self, flag):  # compat no-op
+        pass
+
+    def enable_custom_device(self, device_type, device_id=0):
+        self._device = device_type
+
+
+class _Handle:
+    """Input/output tensor handle (reference: ZeroCopyTensor role)."""
+
+    def __init__(self):
+        self._array = None
+
+    def copy_from_cpu(self, arr):
+        self._array = np.ascontiguousarray(arr)
+
+    def copy_to_cpu(self):
+        return self._array
+
+    def reshape(self, shape):
+        if self._array is not None:
+            self._array = self._array.reshape(shape)
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        from ..jit import load as jit_load
+
+        self._config = config
+        self._layer = jit_load(config.model_path())
+        if self._layer._exported is None:
+            raise ValueError(
+                f"{config.model_path()!r} has no serialized program; re-save the "
+                "model with paddle.jit.save(layer, path, input_spec=[...])")
+        n_in = self._layer._exported.in_avals
+        # first tree arg is the weights dict; the rest are user inputs
+        import jax
+
+        treedef = self._layer._exported.in_tree
+        args_structure = jax.tree_util.treedef_children(treedef)[0]
+        n_user = len(jax.tree_util.treedef_children(args_structure)) - 1
+        self._inputs = [_Handle() for _ in range(n_user)]
+        self._outputs: list[_Handle] = []
+        self._device = config._device
+
+    # ------------------------------------------------------------- handle API
+    def get_input_names(self):
+        return [f"x{i}" for i in range(len(self._inputs))]
+
+    def get_input_handle(self, name):
+        return self._inputs[int(name[1:]) if name.startswith("x") else int(name)]
+
+    def get_output_names(self):
+        return [f"out{i}" for i in range(len(self._outputs))]
+
+    def get_output_handle(self, name):
+        return self._outputs[int(name[3:]) if name.startswith("out") else int(name)]
+
+    # ------------------------------------------------------------- execution
+    def run(self, inputs=None):
+        """Either positional-arrays in / arrays out, or the handle protocol:
+        copy_from_cpu → run() → copy_to_cpu."""
+        import jax
+
+        if inputs is not None:
+            arrays = [np.asarray(x) for x in inputs]
+        else:
+            arrays = [h._array for h in self._inputs]
+            if any(a is None for a in arrays):
+                raise ValueError("input handles not filled; call copy_from_cpu first")
+        out = self._layer.forward(*arrays)
+        flat = jax.tree_util.tree_leaves(out)
+        results = [np.asarray(t._value if hasattr(t, "_value") else t) for t in flat]
+        self._outputs = []
+        for r in results:
+            h = _Handle()
+            h.copy_from_cpu(r)
+            self._outputs.append(h)
+        return results
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -153,45 +153,133 @@ def _call_method(layer, fn, state, args, kwargs):
 
 
 class TranslatedLayer(Layer):
-    """Loaded inference layer (reference: translated_layer.py)."""
+    """Loaded inference layer replaying a serialized StableHLO program.
 
-    def __init__(self, state, meta, forward_fn=None):
+    Reference: python/paddle/jit/translated_layer.py (load + execute without
+    the original model class; the C++ twin is paddle/fluid/jit/layer.h).
+    TPU-native: the program is a ``jax.export`` blob — deserialize once,
+    ``call(state, *inputs)`` per forward; XLA compiles per concrete shape
+    (symbolic batch dims replay at any batch size).
+    """
+
+    def __init__(self, state, meta, exported=None):
         super().__init__()
         self._state = state
         self._meta = meta
-        self._forward_fn = forward_fn
+        self._exported = exported
 
     def forward(self, *args):
-        raise NotImplementedError(
-            "TranslatedLayer from paddle_tpu.jit.load holds weights only; rebuild the "
-            "model class and call set_state_dict — serialized program replay lands with "
-            "the inference runtime."
-        )
+        if self._exported is None:
+            raise RuntimeError(
+                "this checkpoint was saved without a serialized program "
+                "(weights only); rebuild the model class and set_state_dict, or "
+                "re-save with input_spec so paddle_tpu.jit.save exports one")
+        raw = [a._value if isinstance(a, Tensor) else jax_asarray(a) for a in args]
+        out = self._exported.call({k: t._value for k, t in self._state.items()}, *raw)
+        import jax
+
+        return jax.tree.map(Tensor, out) if not hasattr(out, "shape") else Tensor(out)
+
+    def state_dict(self, *a, **k):
+        return dict(self._state)
+
+    @property
+    def program_bytes(self):
+        return self._meta.get("program_nbytes")
+
+
+def _spec_to_aval(spec, scope_holder):
+    """InputSpec/Tensor/ndarray → jax ShapeDtypeStruct; None dims become shared
+    symbolic sizes so the exported program is batch-polymorphic."""
+    import jax
+    from jax import export as jexport
+
+    if hasattr(spec, "_value"):  # Tensor example
+        v = spec._value
+        return jax.ShapeDtypeStruct(v.shape, v.dtype)
+    if isinstance(spec, np.ndarray):
+        return jax.ShapeDtypeStruct(spec.shape, spec.dtype)
+    shape = []
+    for i, d in enumerate(spec.shape):
+        if d is None or (isinstance(d, int) and d < 0):
+            name = f"d{len(scope_holder)}"
+            if name not in scope_holder:
+                scope_holder[name] = jexport.symbolic_shape(name)[0]
+            shape.append(scope_holder[name])
+        else:
+            shape.append(d)
+    return jax.ShapeDtypeStruct(tuple(shape), np.dtype(spec.dtype))
 
 
 def save(layer, path, input_spec=None, **configs):
-    """paddle.jit.save: persist weights + structure metadata. Weights as npz (portable,
-    no pickle trust issues for arrays) + a meta pickle for structure."""
+    """paddle.jit.save: weights npz + serialized StableHLO program + meta.
+
+    Reference: python/paddle/jit/api.py (save → TranslatedLayer contract).
+    With `input_spec` (paddle.static.InputSpec / example Tensors) the forward
+    is traced once and exported via jax.export — the artifact replays in a
+    process that never imports the model class. Without input_spec the save is
+    weights-only (load still works for set_state_dict flows).
+    """
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     if isinstance(layer, StaticFunction):
+        if input_spec is None:
+            input_spec = layer._input_spec
         layer = layer.layer
-    state = {k: np.asarray(v._value) for k, v in layer.state_dict().items()}
+    sd = layer.state_dict()
+    state = {k: np.asarray(v._value) for k, v in sd.items()}
     np.savez(path + ".pdiparams.npz", **state)
     meta = {
         "class_name": type(layer).__name__,
         "state_keys": list(state.keys()),
-        "input_spec": None,
+        "has_program": False,
     }
+    if input_spec is not None:
+        import jax
+        from jax import export as jexport
+
+        was_training = layer.training
+        layer.eval()
+        try:
+            def fwd(raw_state, *inputs):
+                out = layer.functional_call(
+                    raw_state, *[Tensor(x) for x in inputs])
+                # Tensor is itself a registered pytree; unwrap at Tensor
+                # granularity so the exported treedef holds only plain types
+                return jax.tree.map(
+                    lambda t: t._value if isinstance(t, Tensor) else t, out,
+                    is_leaf=lambda t: isinstance(t, Tensor))
+
+            scope: dict = {}
+            state_avals = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                           for k, v in state.items()}
+            in_avals = [_spec_to_aval(s, scope) for s in input_spec]
+            exported = jexport.export(jax.jit(fwd))(state_avals, *in_avals)
+            blob = exported.serialize()
+            with open(path + ".pdmodel", "wb") as f:
+                f.write(blob)
+            meta["has_program"] = True
+            meta["program_nbytes"] = len(blob)
+        finally:
+            if was_training:
+                layer.train()
     with open(path + ".pdmodel.meta", "wb") as f:
         pickle.dump(meta, f)
 
 
 def load(path, **configs):
+    """paddle.jit.load: returns a TranslatedLayer. If the artifact carries a
+    serialized program, forward() replays it without the model class."""
     with open(path + ".pdmodel.meta", "rb") as f:
         meta = pickle.load(f)
     data = np.load(path + ".pdiparams.npz")
     state = {k: Tensor(jax_asarray(data[k])) for k in data.files}
-    return TranslatedLayer(state, meta)
+    exported = None
+    if meta.get("has_program") and os.path.exists(path + ".pdmodel"):
+        from jax import export as jexport
+
+        with open(path + ".pdmodel", "rb") as f:
+            exported = jexport.deserialize(f.read())
+    return TranslatedLayer(state, meta, exported)
 
 
 def jax_asarray(a):

@@ -1,0 +1,48 @@
+"""Minimal paddle.static surface for the trace-and-compile world.
+
+Reference: python/paddle/static/input.py (InputSpec) and the static.nn
+namespace. The legacy Program/Executor machinery is absorbed by jax tracing
+(SURVEY §7.1), but InputSpec survives as the shape/dtype declaration used by
+``paddle.jit.save``'s program export — a None dim becomes a symbolic dimension
+in the exported StableHLO (batch-polymorphic serving).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class InputSpec:
+    """Reference: python/paddle/static/input.py:44."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(str(dtype).replace("paddle.", "")
+                              if not isinstance(dtype, np.dtype) else dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, str(tensor.dtype), name or tensor.name)
+
+    @classmethod
+    def from_numpy(cls, ndarray, name=None):
+        return cls(ndarray.shape, ndarray.dtype, name)
+
+    def batch(self, batch_size):
+        return InputSpec((batch_size,) + self.shape, self.dtype, self.name)
+
+    def unbatch(self):
+        return InputSpec(self.shape[1:], self.dtype, self.name)
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+                f"name={self.name})")
+
+
+def default_main_program():  # compat no-op: jaxpr replaces Program
+    return None
+
+
+def default_startup_program():
+    return None
