@@ -112,16 +112,42 @@ class RowParallelLinear(Layer):
 
 
 class ParallelCrossEntropy(Layer):
-    """Reference mp_layers.py:744: softmax CE over vocab-sharded logits. With GSPMD the
-    reduction over the sharded vocab axis is compiled into the program."""
+    """Reference mp_layers.py:744 (c_softmax_with_cross_entropy): softmax CE over
+    vocab-sharded logits that NEVER materializes a replicated [B,S,V].
+
+    Partition-friendly formulation — every op reduces over (or is elementwise
+    on) the sharded vocab axis, so GSPMD lowers to per-shard partials + [B,S]
+    all-reduces instead of an all-gather of the logits:
+
+        lse  = max_V(logits) + log(sum_V(exp(logits - max)))   # reduce over V
+        tgt  = sum_V(where(iota_V == label, logits, 0))        # reduce over V
+        loss = lse - tgt
+
+    The target logit lives in exactly one vocab shard; the masked-sum turns the
+    gather into a reduction (the reference's c_ops achieve the same with a
+    masked local lookup + allreduce)."""
 
     def __init__(self, mp_group=None, name=None, ignore_index=-100):
         super().__init__()
         self.ignore_index = ignore_index
 
     def forward(self, input, label):
-        return F.cross_entropy(input, label, reduction="none",
-                               ignore_index=self.ignore_index)
+        from ... import ops as P
+
+        vocab = input.shape[-1]
+        squeeze_label = label.ndim == input.ndim and label.shape[-1] == 1
+        lab = label.squeeze(-1) if squeeze_label else label
+        m = P.max(input, axis=-1, keepdim=True)
+        m = m.detach() if hasattr(m, "detach") else m
+        lse = P.log(P.sum(P.exp(input - m), axis=-1)) + m.squeeze(-1)
+        iota = P.arange(vocab, dtype="int64")
+        onehot_mask = P.equal(iota, lab.unsqueeze(-1))
+        tgt = P.sum(P.where(onehot_mask, input,
+                            P.zeros_like(input)), axis=-1)
+        loss = lse - tgt
+        ignore = P.equal(lab, self.ignore_index)
+        loss = P.where(ignore, P.zeros_like(loss), loss)
+        return loss.unsqueeze(-1) if squeeze_label else loss
 
 
 # ------------------------------------------------------------------ pipeline layers

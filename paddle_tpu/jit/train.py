@@ -128,6 +128,19 @@ class TrainStep:
                     else:
                         loss_t = loss_fn(out)
                 loss_v = loss_t._value if isinstance(loss_t, Tensor) else loss_t
+                # auxiliary losses set by sublayers during THIS forward (MoE
+                # gate load-balance l_aux) join the objective automatically —
+                # without this, a user composing GPT+MoE silently trains with
+                # no load balancing (reference wires gate.get_loss() the same
+                # way). Freshness check: the attr must hold a tracer from the
+                # live trace, not a stale concrete value from an eager call.
+                for _l in model.sublayers(include_self=True):
+                    _la = getattr(_l, "l_aux", None)
+                    if _la is None:
+                        continue
+                    _lv = _la._value if isinstance(_la, Tensor) else _la
+                    if isinstance(_lv, jax.core.Tracer):
+                        loss_v = loss_v + _lv.astype(loss_v.dtype)
                 # buffer updates (BN running mean/var) flow out as aux so they
                 # survive functional_call's state restore
                 buffers = {

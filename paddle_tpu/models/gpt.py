@@ -193,9 +193,13 @@ class GPTForCausalLM(Layer):
     def forward(self, input_ids, labels=None, position_ids=None):
         logits = self.gpt(input_ids, position_ids)
         if labels is not None:
-            loss = F.cross_entropy(
-                logits.reshape([-1, logits.shape[-1]]), labels.reshape([-1])
-            )
+            # vocab-sharded CE: reductions over the (possibly mp-sharded) vocab
+            # axis only — never gathers a replicated [B*S, V] (mp_layers.py:744)
+            from ..distributed.fleet.meta_parallel import ParallelCrossEntropy
+
+            per_token = ParallelCrossEntropy()(
+                logits.reshape([-1, logits.shape[-1]]), labels.reshape([-1]))
+            loss = per_token.mean()
             return logits, loss
         return logits
 

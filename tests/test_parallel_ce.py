@@ -1,0 +1,121 @@
+"""Vocab-sharded ParallelCrossEntropy + MoE aux-loss plumbing (VERDICT r2 item 8)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed.fleet.meta_parallel import ParallelCrossEntropy
+
+
+def _ref_ce(logits, labels, ignore_index=-100):
+    """numpy reference: per-token CE, 0 where ignored."""
+    m = logits.max(-1, keepdims=True)
+    lse = np.log(np.exp(logits - m).sum(-1)) + m[..., 0]
+    tgt = np.take_along_axis(logits, np.maximum(labels, 0)[..., None], -1)[..., 0]
+    out = lse - tgt
+    out[labels == ignore_index] = 0.0
+    return out
+
+
+def test_parallel_ce_matches_dense():
+    rng = np.random.default_rng(0)
+    logits = rng.standard_normal((4, 6, 32)).astype("float32")
+    labels = rng.integers(0, 32, (4, 6))
+    labels[0, 0] = -100
+    ce = ParallelCrossEntropy(ignore_index=-100)
+    got = ce(paddle.to_tensor(logits), paddle.to_tensor(labels)).numpy()
+    np.testing.assert_allclose(got, _ref_ce(logits, labels), rtol=1e-5, atol=1e-5)
+
+
+def test_parallel_ce_grad_matches_dense():
+    rng = np.random.default_rng(1)
+    logits_np = rng.standard_normal((3, 16)).astype("float32")
+    labels_np = rng.integers(0, 16, (3,))
+
+    ce = ParallelCrossEntropy()
+    t1 = paddle.to_tensor(logits_np, stop_gradient=False)
+    loss1 = ce(t1, paddle.to_tensor(labels_np)).mean()
+    loss1.backward()
+
+    t2 = paddle.to_tensor(logits_np, stop_gradient=False)
+    loss2 = F.cross_entropy(t2, paddle.to_tensor(labels_np),
+                            reduction="none").mean()
+    loss2.backward()
+    np.testing.assert_allclose(np.asarray(t1.grad), np.asarray(t2.grad),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_parallel_ce_no_allgather_in_hlo():
+    """The point of the layer: vocab-sharded logits must NOT be all-gathered.
+    Compile over an mp mesh with logits sharded on the vocab axis and check the
+    optimized HLO has no all-gather (reductions lower to all-reduce)."""
+    mesh = dist.auto_mesh(8, dim_names=["mp"]).jax_mesh
+    B, S, V = 4, 8, 64
+    rng = np.random.default_rng(2)
+    logits = jax.device_put(
+        rng.standard_normal((B, S, V)).astype("float32"),
+        NamedSharding(mesh, P(None, None, "mp")))
+    labels = jax.device_put(rng.integers(0, V, (B, S)),
+                            NamedSharding(mesh, P()))
+    ce = ParallelCrossEntropy()
+
+    def fn(lg, lb):
+        return ce(paddle.Tensor(lg), paddle.Tensor(lb))._value
+
+    compiled = (
+        jax.jit(fn,
+                in_shardings=(NamedSharding(mesh, P(None, None, "mp")),
+                              NamedSharding(mesh, P())),
+                out_shardings=NamedSharding(mesh, P()))
+        .lower(logits, labels).compile()
+    )
+    hlo = compiled.as_text()
+    assert "all-gather" not in hlo, "vocab-sharded CE must not gather logits"
+    assert "all-reduce" in hlo, "expected per-shard partials + all-reduce"
+    got = np.asarray(jax.device_get(compiled(logits, labels)))
+    ref = _ref_ce(np.asarray(jax.device_get(logits)),
+                  np.asarray(jax.device_get(labels)))
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_trainstep_accumulates_moe_l_aux():
+    """TrainStep must fold MoE gate l_aux into the objective automatically."""
+    from paddle_tpu import nn
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+    from paddle_tpu.jit.train import TrainStep
+
+    class TinyMoE(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.proj = nn.Linear(8, 8)
+            self.moe = MoELayer(
+                d_model=8,
+                experts=[nn.Linear(8, 8) for _ in range(4)],
+                gate="gshard")
+            self.head = nn.Linear(8, 4)
+
+        def forward(self, x):
+            return self.head(self.moe(self.proj(x)))
+
+    rng = np.random.default_rng(3)
+    x = paddle.to_tensor(rng.standard_normal((16, 8)).astype("float32"))
+    y = paddle.to_tensor(rng.integers(0, 4, (16,)))
+
+    with paddle.utils.unique_name.guard():
+        paddle.seed(5)
+        m = TinyMoE()
+    opt = paddle.optimizer.SGD(learning_rate=0.0, parameters=m.parameters())
+    step = TrainStep(m, lambda out, lab: F.cross_entropy(out, lab), opt)
+    step_loss = float(step(x, y).numpy())
+
+    # eager: plain data loss + l_aux should equal the TrainStep objective
+    m.eval(); m.train()
+    data_loss = float(F.cross_entropy(m(x), y).numpy())
+    l_aux = float(m.moe.l_aux.numpy())
+    assert l_aux > 0.0
+    assert step_loss == pytest.approx(data_loss + l_aux, rel=1e-4), (
+        step_loss, data_loss, l_aux)
