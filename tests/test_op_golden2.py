@@ -1,0 +1,331 @@
+"""Golden op table, part 2: manipulation / linalg / nn.functional / losses."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from op_test import check_op, rand, randb, randint, randpos
+
+P = paddle
+
+
+def op(id, fn, ref, inputs, **opts):
+    return dict(id=id, fn=fn, ref=ref, inputs=inputs, opts=opts)
+
+
+NO_GRAD = dict(check_grad=False)
+
+MANIP = [
+    op("reshape", lambda x: P.reshape(x, [4, 3]), lambda x: x.reshape(4, 3),
+       lambda: [rand((3, 4))]),
+    op("reshape_infer", lambda x: P.reshape(x, [-1, 6]), lambda x: x.reshape(-1, 6),
+       lambda: [rand((3, 4))]),
+    op("transpose", lambda x: P.transpose(x, [1, 0]), lambda x: x.T,
+       lambda: [rand((3, 4))]),
+    op("transpose3", lambda x: P.transpose(x, [2, 0, 1]),
+       lambda x: np.transpose(x, (2, 0, 1)), lambda: [rand((2, 3, 4))]),
+    op("concat", lambda a, b: P.concat([a, b], axis=1),
+       lambda a, b: np.concatenate([a, b], 1),
+       lambda: [rand((3, 2)), rand((3, 5))]),
+    op("stack", lambda a, b: P.stack([a, b], axis=0),
+       lambda a, b: np.stack([a, b], 0), lambda: [rand((3, 4)), rand((3, 4))]),
+    op("split", lambda x: P.split(x, 2, axis=1),
+       lambda x: np.split(x, 2, 1), lambda: [rand((3, 6))]),
+    op("split_sections", lambda x: P.split(x, [2, 4], axis=1),
+       lambda x: np.split(x, [2], 1), lambda: [rand((3, 6))]),
+    op("chunk", lambda x: P.chunk(x, 3, axis=0),
+       lambda x: np.split(x, 3, 0), lambda: [rand((6, 2))]),
+    op("squeeze", lambda x: P.squeeze(x, axis=1), lambda x: x.squeeze(1),
+       lambda: [rand((3, 1, 4))]),
+    op("unsqueeze", lambda x: P.unsqueeze(x, axis=0), lambda x: x[None],
+       lambda: [rand((3, 4))]),
+    op("flatten", P.flatten, lambda x: x.reshape(-1), lambda: [rand((2, 3, 4))]),
+    op("flatten_range", lambda x: P.flatten(x, start_axis=1, stop_axis=2),
+       lambda x: x.reshape(2, 12), lambda: [rand((2, 3, 4))]),
+    op("tile", lambda x: P.tile(x, [2, 3]), lambda x: np.tile(x, (2, 3)),
+       lambda: [rand((2, 2))]),
+    op("expand", lambda x: P.expand(x, [3, 4]),
+       lambda x: np.broadcast_to(x, (3, 4)).copy(), lambda: [rand((1, 4))]),
+    op("broadcast_to", lambda x: P.broadcast_to(x, [3, 4]),
+       lambda x: np.broadcast_to(x, (3, 4)).copy(), lambda: [rand((4,))]),
+    op("roll", lambda x: P.roll(x, 2, axis=1), lambda x: np.roll(x, 2, 1),
+       lambda: [rand((3, 5))]),
+    op("roll_flat", lambda x: P.roll(x, 3), lambda x: np.roll(x, 3),
+       lambda: [rand((3, 5))], **NO_GRAD),
+    op("flip", lambda x: P.flip(x, axis=1), lambda x: np.flip(x, 1).copy(),
+       lambda: [rand((3, 4))]),
+    op("rot90", lambda x: P.rot90(x), lambda x: np.rot90(x).copy(),
+       lambda: [rand((3, 4))], **NO_GRAD),
+    op("gather", lambda x, i: P.gather(x, i, axis=0),
+       lambda x, i: np.take(x, i, 0), lambda: [rand((5, 3)), randint((4,), 0, 5)]),
+    op("index_select", lambda x, i: P.index_select(x, i, axis=1),
+       lambda x, i: np.take(x, i, 1), lambda: [rand((3, 5)), randint((2,), 0, 5)]),
+    op("take_along_axis", lambda x, i: P.take_along_axis(x, i, axis=1),
+       lambda x, i: np.take_along_axis(x, i, 1),
+       lambda: [rand((3, 5)), randint((3, 2), 0, 5)]),
+    op("gather_nd", lambda x, i: P.gather_nd(x, i),
+       lambda x, i: x[tuple(i.T)],
+       lambda: [rand((4, 5)), randint((3, 2), 0, 4)], **NO_GRAD),
+    op("unbind", lambda x: P.unbind(x, axis=0),
+       lambda x: [x[0], x[1], x[2]], lambda: [rand((3, 4))]),
+    op("clip", lambda x: P.clip(x, -0.5, 0.5), lambda x: np.clip(x, -0.5, 0.5),
+       lambda: [rand((3, 4))]),
+    op("pad_2d", lambda x: F.pad(x, [1, 2], value=0.0),
+       lambda x: np.pad(x, ((0, 0), (1, 2))), lambda: [rand((3, 4))]),
+    op("repeat_interleave", lambda x: P.repeat_interleave(x, 2, axis=1),
+       lambda x: np.repeat(x, 2, 1), lambda: [rand((2, 3))]),
+    op("moveaxis", lambda x: P.moveaxis(x, 0, 2),
+       lambda x: np.moveaxis(x, 0, 2), lambda: [rand((2, 3, 4))]),
+    op("diff", lambda x: P.diff(x, axis=1), lambda x: np.diff(x, axis=1),
+       lambda: [rand((3, 5))]),
+    op("cast", lambda x: P.cast(x, "float32"), lambda x: x.astype("float32"),
+       lambda: [rand((3, 4))], **NO_GRAD),
+    op("scatter", lambda x, i, u: P.scatter(x, i, u),
+       lambda x, i, u: _scatter_ref(x, i, u),
+       lambda: [rand((5, 3)), np.array([0, 2]), rand((2, 3))], **NO_GRAD),
+    op("put_along_axis", lambda x, i, u: P.put_along_axis(x, i, u, axis=1),
+       lambda x, i, u: _put_along_ref(x, i, u),
+       lambda: [rand((3, 5)), randint((3, 1), 0, 5), rand((3, 1))], **NO_GRAD),
+    op("masked_select", lambda x, m: P.masked_select(x, m), lambda x, m: x[m],
+       lambda: [rand((3, 4)), randb((3, 4))],
+       check_grad=False, check_jit=False),
+    op("tensordot", lambda a, b: P.tensordot(a, b, axes=1),
+       lambda a, b: np.tensordot(a, b, 1), lambda: [rand((3, 4)), rand((4, 5))]),
+    op("atleast_2d", lambda x: P.atleast_2d(x), lambda x: np.atleast_2d(x),
+       lambda: [rand((4,))], **NO_GRAD),
+]
+
+
+def _scatter_ref(x, i, u):
+    out = x.copy()
+    out[i] = u
+    return out
+
+
+def _put_along_ref(x, i, u):
+    out = x.copy()
+    np.put_along_axis(out, i, u, 1)
+    return out
+
+
+def _spd(n):
+    a = rand((n, n))
+    return a @ a.T + n * np.eye(n)
+
+
+LINALG = [
+    op("matmul", P.matmul, np.matmul, lambda: [rand((3, 4)), rand((4, 5))]),
+    op("matmul_batched", P.matmul, np.matmul,
+       lambda: [rand((2, 3, 4)), rand((2, 4, 5))]),
+    op("matmul_transpose", lambda a, b: P.matmul(a, b, transpose_y=True),
+       lambda a, b: a @ b.T, lambda: [rand((3, 4)), rand((5, 4))]),
+    op("dot", P.dot, np.dot, lambda: [rand((5,)), rand((5,))]),
+    op("bmm", P.bmm, np.matmul, lambda: [rand((2, 3, 4)), rand((2, 4, 2))]),
+    op("mv", P.mv, np.matmul, lambda: [rand((3, 4)), rand((4,))]),
+    op("outer", P.outer, np.outer, lambda: [rand((3,)), rand((4,))]),
+    op("inner", P.inner, np.inner, lambda: [rand((3, 4)), rand((5, 4))]),
+    op("kron", P.kron, np.kron, lambda: [rand((2, 2)), rand((2, 3))]),
+    op("t", P.t, np.transpose, lambda: [rand((3, 4))]),
+    op("norm_fro", lambda x: P.norm(x), lambda x: np.linalg.norm(x),
+       lambda: [rand((3, 4))]),
+    op("norm_1", lambda x: P.norm(x, p=1, axis=1),
+       lambda x: np.abs(x).sum(1), lambda: [rand((3, 4))]),
+    op("norm_inf", lambda x: P.norm(x, p=np.inf, axis=1),
+       lambda x: np.abs(x).max(1), lambda: [rand((3, 4))], **NO_GRAD),
+    op("dist", lambda a, b: P.dist(a, b, p=2),
+       lambda a, b: np.linalg.norm((a - b).reshape(-1)),
+       lambda: [rand((3, 4)), rand((3, 4))]),
+    op("cross", lambda a, b: P.cross(a, b, axis=1), lambda a, b: np.cross(a, b),
+       lambda: [rand((4, 3)), rand((4, 3))]),
+    op("trace_linalg", lambda x: paddle.linalg.multi_dot([x, x]) if False else
+       P.diagonal(x).sum(), lambda x: np.trace(x), lambda: [rand((4, 4))]),
+    op("cholesky", lambda x: paddle.linalg.cholesky(x),
+       lambda x: np.linalg.cholesky(x), lambda: [_spd(4)], **NO_GRAD),
+    op("inverse", lambda x: paddle.linalg.inverse(x), np.linalg.inv,
+       lambda: [_spd(4)], **NO_GRAD),
+    op("det", paddle.linalg.det, np.linalg.det, lambda: [_spd(3)]),
+    op("slogdet", lambda x: paddle.linalg.slogdet(x),
+       lambda x: np.array(np.linalg.slogdet(x)), lambda: [_spd(3)], **NO_GRAD),
+    op("matrix_power", lambda x: paddle.linalg.matrix_power(x, 3),
+       lambda x: np.linalg.matrix_power(x, 3), lambda: [rand((3, 3))], **NO_GRAD),
+    op("solve", paddle.linalg.solve, np.linalg.solve,
+       lambda: [_spd(3), rand((3, 2))], **NO_GRAD),
+    op("pinv", paddle.linalg.pinv, np.linalg.pinv, lambda: [rand((4, 3))],
+       **NO_GRAD, rtol=1e-5, atol=1e-6),
+    op("einsum_ij", lambda a, b: P.einsum("ij,jk->ik", a, b),
+       lambda a, b: np.einsum("ij,jk->ik", a, b),
+       lambda: [rand((3, 4)), rand((4, 5))]),
+    op("einsum_batch", lambda a, b: P.einsum("bij,bjk->bik", a, b),
+       lambda a, b: np.einsum("bij,bjk->bik", a, b),
+       lambda: [rand((2, 3, 4)), rand((2, 4, 5))]),
+    op("einsum_trace", lambda a: P.einsum("ii->", a),
+       lambda a: np.einsum("ii->", a), lambda: [rand((4, 4))]),
+    op("addmm", lambda c, a, b: P.addmm(c, a, b, alpha=2.0, beta=0.5),
+       lambda c, a, b: 0.5 * c + 2.0 * (a @ b),
+       lambda: [rand((3, 5)), rand((3, 4)), rand((4, 5))]),
+]
+
+
+def _np_softmax(x, axis=-1):
+    m = x.max(axis, keepdims=True)
+    e = np.exp(x - m)
+    return e / e.sum(axis, keepdims=True)
+
+
+def _np_gelu(x):
+    from math import erf as _e
+
+    return x * 0.5 * (1 + np.vectorize(_e)(x / np.sqrt(2.0)))
+
+
+ACTIVATIONS = [
+    op("relu", F.relu, lambda x: np.maximum(x, 0), lambda: [rand((3, 4))]),
+    op("relu6", F.relu6, lambda x: np.clip(x, 0, 6), lambda: [rand((3, 4), lo=-8, hi=8)]),
+    op("gelu", F.gelu, _np_gelu, lambda: [rand((3, 4))], grad_rtol=1e-3),
+    op("silu", F.silu, lambda x: x / (1 + np.exp(-x)), lambda: [rand((3, 4))]),
+    op("sigmoid", F.sigmoid, lambda x: 1 / (1 + np.exp(-x)), lambda: [rand((3, 4))]),
+    op("softmax", F.softmax, _np_softmax, lambda: [rand((3, 4))]),
+    op("softmax_axis0", lambda x: F.softmax(x, axis=0),
+       lambda x: _np_softmax(x, 0), lambda: [rand((3, 4))]),
+    op("log_softmax", F.log_softmax,
+       lambda x: np.log(_np_softmax(x)), lambda: [rand((3, 4))]),
+    op("hardtanh", F.hardtanh, lambda x: np.clip(x, -1, 1), lambda: [rand((3, 4))]),
+    op("leaky_relu", F.leaky_relu,
+       lambda x: np.where(x > 0, x, 0.01 * x), lambda: [rand((3, 4))]),
+    op("elu", F.elu, lambda x: np.where(x > 0, x, np.exp(x) - 1),
+       lambda: [rand((3, 4))]),
+    op("selu", F.selu,
+       lambda x: 1.0507009873554805 * np.where(
+           x > 0, x, 1.6732632423543772 * (np.exp(x) - 1)),
+       lambda: [rand((3, 4))]),
+    op("softplus", F.softplus, lambda x: np.log1p(np.exp(x)), lambda: [rand((3, 4))]),
+    op("softsign", F.softsign, lambda x: x / (1 + np.abs(x)), lambda: [rand((3, 4))]),
+    op("tanhshrink", F.tanhshrink, lambda x: x - np.tanh(x), lambda: [rand((3, 4))]),
+    op("hardshrink", F.hardshrink,
+       lambda x: np.where(np.abs(x) > 0.5, x, 0), lambda: [rand((3, 4))]),
+    op("softshrink", F.softshrink,
+       lambda x: np.where(x > 0.5, x - 0.5, np.where(x < -0.5, x + 0.5, 0)),
+       lambda: [rand((3, 4))]),
+    op("hardsigmoid", F.hardsigmoid,
+       lambda x: np.clip(x / 6 + 0.5, 0, 1), lambda: [rand((3, 4), lo=-8, hi=8)]),
+    op("hardswish", F.hardswish,
+       lambda x: x * np.clip(x + 3, 0, 6) / 6, lambda: [rand((3, 4), lo=-8, hi=8)]),
+    op("mish", F.mish, lambda x: x * np.tanh(np.log1p(np.exp(x))),
+       lambda: [rand((3, 4))]),
+    op("glu", F.glu,
+       lambda x: x[:, :2] * (1 / (1 + np.exp(-x[:, 2:]))), lambda: [rand((3, 4))]),
+    op("one_hot", lambda i: F.one_hot(i, num_classes=5),
+       lambda i: np.eye(5, dtype="float32")[i], lambda: [randint((6,), 0, 5)],
+       **NO_GRAD),
+    op("linear", F.linear,
+       lambda x, w, b: x @ w + b, lambda: [rand((3, 4)), rand((4, 5)), rand((5,))]),
+    op("normalize", F.normalize,
+       lambda x: x / np.maximum(np.linalg.norm(x, axis=-1, keepdims=True), 1e-12),
+       lambda: [rand((3, 4))]),
+    op("cosine_similarity", F.cosine_similarity,
+       lambda a, b: (a * b).sum(-1) / (np.linalg.norm(a, axis=-1)
+                                       * np.linalg.norm(b, axis=-1)),
+       lambda: [rand((3, 4)), rand((3, 4))]),
+]
+
+
+def _np_ce(logits, labels):
+    ls = np.log(_np_softmax(logits))
+    return -ls[np.arange(len(labels)), labels].mean()
+
+
+LOSSES = [
+    op("mse_loss", F.mse_loss, lambda a, b: ((a - b) ** 2).mean(),
+       lambda: [rand((3, 4)), rand((3, 4))]),
+    op("l1_loss", F.l1_loss, lambda a, b: np.abs(a - b).mean(),
+       lambda: [rand((3, 4)), rand((3, 4))]),
+    op("smooth_l1_loss", F.smooth_l1_loss,
+       lambda a, b: np.where(np.abs(a - b) < 1.0, 0.5 * (a - b) ** 2,
+                             np.abs(a - b) - 0.5).mean(),
+       lambda: [rand((3, 4)), rand((3, 4))]),
+    op("cross_entropy", lambda x, y: F.cross_entropy(x, y), _np_ce,
+       lambda: [rand((4, 5)), randint((4,), 0, 5)]),
+    op("nll_loss", lambda x, y: F.nll_loss(x, y),
+       lambda x, y: -x[np.arange(len(y)), y].mean(),
+       lambda: [rand((4, 5)), randint((4,), 0, 5)]),
+    op("kl_div", lambda p, q: F.kl_div(p, q, reduction="mean"),
+       lambda lp, t: (t * (np.log(t) - lp)).mean(),
+       lambda: [np.log(_np_softmax(rand((3, 4)))), _np_softmax(rand((3, 4)))],
+       grad_indices=[0]),
+    op("binary_cross_entropy", F.binary_cross_entropy,
+       lambda p, t: -(t * np.log(p) + (1 - t) * np.log(1 - p)).mean(),
+       lambda: [rand((3, 4), lo=0.1, hi=0.9), randb((3, 4)).astype("float64")],
+       grad_indices=[0]),
+    op("bce_with_logits", F.binary_cross_entropy_with_logits,
+       lambda x, t: (np.maximum(x, 0) - x * t + np.log1p(np.exp(-np.abs(x)))).mean(),
+       lambda: [rand((3, 4)), randb((3, 4)).astype("float64")], grad_indices=[0]),
+    op("square_error_cost", F.square_error_cost, lambda a, b: (a - b) ** 2,
+       lambda: [rand((3, 4)), rand((3, 4))]),
+    op("label_smooth", lambda x: F.label_smooth(x, epsilon=0.1),
+       lambda x: x * 0.9 + 0.1 / x.shape[-1], lambda: [rand((3, 4), lo=0.01, hi=0.99)]),
+]
+
+
+def _np_avgpool2d(x, k):
+    n, c, h, w = x.shape
+    return x.reshape(n, c, h // k, k, w // k, k).mean((3, 5))
+
+
+def _np_maxpool2d(x, k):
+    n, c, h, w = x.shape
+    return x.reshape(n, c, h // k, k, w // k, k).max((3, 5))
+
+
+NN_SHAPE = [
+    op("avg_pool2d", lambda x: F.avg_pool2d(x, kernel_size=2),
+       lambda x: _np_avgpool2d(x, 2), lambda: [rand((2, 3, 4, 4))]),
+    op("max_pool2d", lambda x: F.max_pool2d(x, kernel_size=2),
+       lambda x: _np_maxpool2d(x, 2), lambda: [rand((2, 3, 4, 4))]),
+    op("adaptive_avg_pool2d", lambda x: F.adaptive_avg_pool2d(x, 1),
+       lambda x: x.mean((2, 3), keepdims=True), lambda: [rand((2, 3, 4, 4))]),
+    op("layer_norm", lambda x, w, b: F.layer_norm(x, [4], weight=None, bias=None),
+       lambda x, w, b: (x - x.mean(-1, keepdims=True))
+       / np.sqrt(x.var(-1, keepdims=True) + 1e-5),
+       lambda: [rand((3, 4)), rand((4,)), rand((4,))], grad_indices=[0],
+       grad_rtol=1e-3),
+    op("embedding", lambda i, w: F.embedding(i, w), lambda i, w: w[i],
+       lambda: [randint((5,), 0, 7), rand((7, 3))]),
+    op("dropout_eval", lambda x: F.dropout(x, p=0.5, training=False),
+       lambda x: x, lambda: [rand((3, 4))]),
+    op("conv2d_identity",
+       lambda x, w: F.conv2d(x, w),
+       lambda x, w: np.stack(
+           [sum(x[:, ci] * w[co, ci, 0, 0] for ci in range(x.shape[1]))
+            for co in range(w.shape[0])], 1),
+       lambda: [rand((2, 3, 5, 5)), rand((4, 3, 1, 1))], grad_rtol=1e-3),
+    op("unfold", lambda x: F.unfold(x, kernel_sizes=2),
+       lambda x: _np_unfold2(x), lambda: [rand((1, 2, 3, 3))], **NO_GRAD),
+    op("pixel_shuffle", lambda x: F.pixel_shuffle(x, 2),
+       lambda x: _np_pixel_shuffle(x, 2), lambda: [rand((1, 4, 2, 2))], **NO_GRAD),
+]
+
+
+def _np_unfold2(x):
+    n, c, h, w = x.shape
+    cols = []
+    for i in range(h - 1):
+        for j in range(w - 1):
+            cols.append(x[:, :, i:i + 2, j:j + 2].reshape(n, -1))
+    return np.stack(cols, -1)
+
+
+def _np_pixel_shuffle(x, r):
+    n, c, h, w = x.shape
+    x = x.reshape(n, c // r**2, r, r, h, w)
+    x = x.transpose(0, 1, 4, 2, 5, 3)
+    return x.reshape(n, c // r**2, h * r, w * r)
+
+
+SPECS = [s for s in MANIP + LINALG + ACTIVATIONS + LOSSES + NN_SHAPE
+         if s is not None]
+
+
+@pytest.mark.parametrize("spec", SPECS, ids=[s["id"] for s in SPECS])
+def test_golden2(spec):
+    check_op(spec["id"], spec["fn"], spec["ref"], spec["inputs"](),
+             **spec["opts"])
