@@ -360,20 +360,88 @@ def shift(tensor, offset=1, group=None):
     return tensor if isinstance(tensor, Tensor) else Tensor(v)
 
 
+def _p2p_store():
+    """The launch control-plane store, when this process was started by
+    paddle_tpu.distributed.launch (env.py connects it)."""
+    from . import env as _env
+
+    return getattr(_env, "_store", None)
+
+
+def _serialize_array(arr):
+    """Explicit dtype/shape header + raw bytes: np.save would write ml_dtypes
+    arrays (bfloat16, fp8 — the default TPU training dtypes) as opaque void."""
+    import json
+    import struct as _struct
+
+    a = np.asarray(arr)
+    header = json.dumps({"dtype": str(a.dtype), "shape": list(a.shape)}).encode()
+    return _struct.pack("<I", len(header)) + header + a.tobytes()
+
+
+def _deserialize_array(blob):
+    import json
+    import struct as _struct
+
+    (hlen,) = _struct.unpack("<I", blob[:4])
+    meta = json.loads(blob[4:4 + hlen].decode())
+    try:
+        dt = np.dtype(meta["dtype"])
+    except TypeError:
+        import ml_dtypes
+
+        dt = np.dtype(getattr(ml_dtypes, meta["dtype"]))
+    return np.frombuffer(blob[4 + hlen:], dtype=dt).reshape(meta["shape"])
+
+
+_p2p_seq: dict = {}
+_p2p_buffer: dict = {}
+
+
 def send(tensor, dst=0, group=None, sync_op=True):
-    """Eager single-process p2p stand-in (host buffer). Inside compiled
-    programs use `shift` (ppermute) or batch_isend_irecv with a ring pattern."""
-    _p2p_buffer.setdefault(dst, []).append(np.asarray(tensor._value))
+    """Point-to-point send. Semantics by context:
+
+    - inside a compiled program: NOT representable (XLA p2p is the collective
+      ppermute) — raises; use `shift` or `batch_isend_irecv` ring patterns.
+    - multi-process job (launched): the payload rides the control-plane TCP
+      store under p2p/<src>-><dst>/<seq>; recv on the peer blocks for it.
+      Control-plane bandwidth: meant for small host tensors (metadata, stop
+      signals), not bulk activations — those belong in-program on ICI.
+    - single process: a local queue (self-send), matching the reference's
+      same-rank fast path."""
+    v = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    if _in_trace(v):
+        raise RuntimeError(
+            "send/recv cannot appear inside a compiled program on TPU; use "
+            "dist.shift (ppermute) or dist.batch_isend_irecv ring exchanges")
+    me = env.get_rank()
+    store = _p2p_store()
+    if store is not None and env.get_world_size() > 1:
+        seq = _p2p_seq[(me, dst)] = _p2p_seq.get((me, dst), -1) + 1
+        store.set(f"p2p/{me}->{dst}/{seq}", _serialize_array(v))
+        return
+    _p2p_buffer.setdefault(dst, []).append(np.asarray(v))
 
 
-def recv(tensor, src=0, group=None, sync_op=True):
-    buf = _p2p_buffer.get(env.get_rank(), [])
+def recv(tensor, src=0, group=None, sync_op=True, timeout=120.0):
+    v = tensor._value if isinstance(tensor, Tensor) else None
+    if v is not None and _in_trace(v):
+        raise RuntimeError(
+            "send/recv cannot appear inside a compiled program on TPU; use "
+            "dist.shift (ppermute) or dist.batch_isend_irecv ring exchanges")
+    me = env.get_rank()
+    store = _p2p_store()
+    if store is not None and env.get_world_size() > 1:
+        seq = _p2p_seq[("r", src, me)] = _p2p_seq.get(("r", src, me), -1) + 1
+        key = f"p2p/{src}->{me}/{seq}"
+        blob = store.get(key, timeout=timeout)
+        store.delete_key(key)
+        tensor._value = jnp.asarray(_deserialize_array(blob))
+        return tensor
+    buf = _p2p_buffer.get(me, [])
     if buf:
         tensor._value = jnp.asarray(buf.pop(0))
     return tensor
-
-
-_p2p_buffer: dict = {}
 
 
 def isend(tensor, dst=0, group=None):

@@ -1,0 +1,62 @@
+"""paddle.fft — spectral ops over XLA's FFT.
+
+Reference: python/paddle/fft.py (fft/ifft/rfft/... with norm= semantics).
+TPU note: XLA lowers FFTs natively; stick to power-of-two sizes for the fast
+path on device. All functions accept Tensor or array-like and return Tensor.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .ops import apply_op
+from .tensor import Tensor
+
+
+def _wrap1(jfn, name):
+    def fn(x, n=None, axis=-1, norm="backward", **kw):
+        return apply_op(lambda v: jfn(v, n=n, axis=axis, norm=norm), name, x)
+
+    fn.__name__ = name
+    return fn
+
+
+def _wrapn(jfn, name, default_axes=None):
+    def fn(x, s=None, axes=default_axes, norm="backward", **kw):
+        return apply_op(lambda v: jfn(v, s=s, axes=axes, norm=norm), name, x)
+
+    fn.__name__ = name
+    return fn
+
+
+fft = _wrap1(jnp.fft.fft, "fft")
+ifft = _wrap1(jnp.fft.ifft, "ifft")
+rfft = _wrap1(jnp.fft.rfft, "rfft")
+irfft = _wrap1(jnp.fft.irfft, "irfft")
+hfft = _wrap1(jnp.fft.hfft, "hfft")
+ihfft = _wrap1(jnp.fft.ihfft, "ihfft")
+
+fft2 = _wrapn(jnp.fft.fft2, "fft2", default_axes=(-2, -1))
+ifft2 = _wrapn(jnp.fft.ifft2, "ifft2", default_axes=(-2, -1))
+rfft2 = _wrapn(jnp.fft.rfft2, "rfft2", default_axes=(-2, -1))
+irfft2 = _wrapn(jnp.fft.irfft2, "irfft2", default_axes=(-2, -1))
+
+fftn = _wrapn(jnp.fft.fftn, "fftn")
+ifftn = _wrapn(jnp.fft.ifftn, "ifftn")
+rfftn = _wrapn(jnp.fft.rfftn, "rfftn")
+irfftn = _wrapn(jnp.fft.irfftn, "irfftn")
+
+
+def fftfreq(n, d=1.0, dtype=None):
+    return Tensor(jnp.fft.fftfreq(n, d).astype(dtype or "float32"))
+
+
+def rfftfreq(n, d=1.0, dtype=None):
+    return Tensor(jnp.fft.rfftfreq(n, d).astype(dtype or "float32"))
+
+
+def fftshift(x, axes=None):
+    return apply_op(lambda v: jnp.fft.fftshift(v, axes=axes), "fftshift", x)
+
+
+def ifftshift(x, axes=None):
+    return apply_op(lambda v: jnp.fft.ifftshift(v, axes=axes), "ifftshift", x)

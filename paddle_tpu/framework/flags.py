@@ -1,0 +1,127 @@
+"""Global flag registry: paddle.set_flags / paddle.get_flags.
+
+Reference: paddle/common/flags.cc (typed FLAGS_* definitions) +
+python/paddle/base/framework.py set_flags/get_flags. TPU-native: flags that
+map onto jax/XLA config apply immediately through a setter hook; the rest are
+typed, validated state that subsystems read (e.g. FLAGS_check_nan_inf is
+consulted by the op dispatcher). Env vars named FLAGS_* seed initial values.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable
+
+
+class _Flag:
+    def __init__(self, name, default, typ, help_str="", on_set: Callable | None = None):
+        self.name = name
+        self.type = typ
+        self.help = help_str
+        self.on_set = on_set
+        env = os.environ.get(name)
+        self.value = self._coerce(env) if env is not None else default
+
+    def _coerce(self, v):
+        if self.type is bool:
+            if isinstance(v, str):
+                return v.lower() in ("1", "true", "yes", "on")
+            return bool(v)
+        return self.type(v)
+
+    def set(self, v):
+        self.value = self._coerce(v)
+        if self.on_set is not None:
+            self.on_set(self.value)
+
+
+def _set_matmul_precision(val: str):
+    import jax
+
+    allowed = {"default", "high", "highest", "bfloat16", "tensorfloat32", "float32"}
+    if val in allowed:
+        jax.config.update("jax_default_matmul_precision",
+                          None if val == "default" else val)
+
+
+def _set_deterministic(val: bool):
+    # XLA determinism: affects scatter/reduction order on device. XLA_FLAGS is
+    # read once at client creation — setting this after the backend exists
+    # cannot change the running process, so say so instead of silently no-oping.
+    flags = os.environ.get("XLA_FLAGS", "")
+    tok = "--xla_gpu_deterministic_ops=true"
+    if val and tok not in flags:
+        os.environ["XLA_FLAGS"] = (flags + " " + tok).strip()
+    elif not val and tok in flags:
+        os.environ["XLA_FLAGS"] = flags.replace(tok, "").strip()
+    import jax._src.xla_bridge as _xb
+
+    if getattr(_xb, "_backends", None):
+        import warnings
+
+        warnings.warn(
+            "FLAGS_cudnn_deterministic changes XLA_FLAGS, which the already-"
+            "initialized XLA backend will not re-read; set it before the first "
+            "device op (or in the environment) for it to take effect",
+            RuntimeWarning)
+
+
+_REGISTRY: dict[str, _Flag] = {}
+
+
+def _define(name, default, typ, help_str="", on_set=None):
+    _REGISTRY[name] = _Flag(name, default, typ, help_str, on_set)
+
+
+# ------------------------------------------------------------------ definitions
+# numerics / debugging
+_define("FLAGS_check_nan_inf", False, bool,
+        "scan op outputs for NaN/Inf at eager dispatch (debugging)")
+_define("FLAGS_check_nan_inf_level", 0, int,
+        "0: error on NaN/Inf; 1+: warn only")
+_define("FLAGS_cudnn_deterministic", False, bool,
+        "deterministic device kernels", _set_deterministic)
+_define("FLAGS_matmul_precision", "default", str,
+        "default|high|highest — MXU accumulation precision",
+        _set_matmul_precision)
+# memory (informational on TPU: XLA owns allocation; kept for API parity)
+_define("FLAGS_fraction_of_gpu_memory_to_use", 0.92, float,
+        "device memory fraction (PJRT preallocation)")
+_define("FLAGS_allocator_strategy", "auto_growth", str,
+        "allocator strategy (XLA-managed on TPU)")
+_define("FLAGS_eager_delete_tensor_gb", 0.0, float, "GC threshold")
+# execution
+_define("FLAGS_use_mkldnn", False, bool, "no-op on TPU")
+_define("FLAGS_benchmark", False, bool, "sync-and-time every op")
+_define("FLAGS_paddle_num_threads", 1, int, "host threads per op")
+# distributed
+_define("FLAGS_call_stack_level", 1, int, "error verbosity")
+_define("FLAGS_log_memory_stats", False, bool, "log live/peak memory each step")
+
+
+def set_flags(flags: dict[str, Any]):
+    """Reference: framework.py set_flags. Unknown names raise ValueError."""
+    if not isinstance(flags, dict):
+        raise TypeError("set_flags expects a dict of {flag_name: value}")
+    for k, v in flags.items():
+        if k not in _REGISTRY:
+            raise ValueError(f"flag {k!r} is not defined (see paddle.get_flags())")
+        _REGISTRY[k].set(v)
+
+
+def get_flags(flags=None) -> dict[str, Any]:
+    """Reference: framework.py get_flags. None → all flags."""
+    if flags is None:
+        return {k: f.value for k, f in _REGISTRY.items()}
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for k in flags:
+        if k not in _REGISTRY:
+            raise ValueError(f"flag {k!r} is not defined")
+        out[k] = _REGISTRY[k].value
+    return out
+
+
+def flag(name: str):
+    """Fast internal accessor (no dict copy)."""
+    return _REGISTRY[name].value

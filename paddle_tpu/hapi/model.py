@@ -17,12 +17,17 @@ class Model:
         self._loss = None
         self._metrics = []
         self.stop_training = False
+        self._train_step = None       # compiled TrainStep (reference model.py:1098
+        self._train_step_broken = False  # runs _run_one_epoch through the
+        # prepared Executor program; our analog is the one-XLA-launch TrainStep)
 
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
         self._optimizer = optimizer
         self._loss = loss
         if metrics is not None:
             self._metrics = metrics if isinstance(metrics, (list, tuple)) else [metrics]
+        self._train_step = None
+        self._train_step_broken = False
         return self
 
     def _compute_loss(self, outputs, labels):
@@ -30,9 +35,58 @@ class Model:
             return self._loss(outputs, labels)
         raise RuntimeError("call prepare(loss=...) first")
 
+    def _compiled_step(self):
+        if self._train_step is None and not self._train_step_broken:
+            from ..jit.train import TrainStep
+
+            # split_label: hapi KNOWS the last arg is the label — don't let
+            # TrainStep's signature heuristic bind it into an optional forward
+            # param (e.g. forward(self, x, mask=None))
+            self._train_step = TrainStep(
+                self.network, self._compute_loss, self._optimizer,
+                return_outputs=bool(self._metrics), split_label=True)
+            self._step_proven = False
+        return self._train_step
+
     def train_batch(self, inputs, labels=None, update=True):
         self.network.train()
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        if update and self._optimizer is not None and not self._train_step_broken:
+            # fast path: the whole (fwd, bwd, clip, update) step is ONE compiled
+            # XLA program. Models whose forward can't trace (data-dependent
+            # Python control flow) fall back to the eager loop permanently.
+            import jax.errors as jerr
+
+            trace_errors = (jerr.TracerArrayConversionError,
+                            jerr.TracerBoolConversionError,
+                            jerr.ConcretizationTypeError,
+                            jerr.TracerIntegerConversionError)
+            step = self._compiled_step()
+            snapshot = None
+            if not getattr(self, "_step_proven", False):
+                inner = getattr(self._optimizer, "_inner_opt", self._optimizer)
+                snapshot = (inner, inner._step_count, step._seed)
+            try:
+                if self._metrics:
+                    loss, out = step(*inputs, labels)
+                else:
+                    loss, out = step(*inputs, labels), None
+                self._step_proven = True
+                for m in self._metrics:
+                    m.update(m.compute(out, labels))
+                return [float(np.asarray(loss._value))]
+            except trace_errors:
+                import warnings
+
+                warnings.warn("Model.fit: forward is not traceable; falling "
+                              "back to the eager per-op path", RuntimeWarning)
+                self._train_step_broken = True
+                self._train_step = None
+                if snapshot is not None:
+                    # _prep_inputs already advanced the step counter / RNG
+                    # seed; the eager step below must not double-count
+                    inner, count, seed = snapshot
+                    inner._step_count = count
         out = self.network(*inputs)
         loss = self._compute_loss(out, labels)
         loss.backward()

@@ -57,10 +57,18 @@ class TrainStep:
     parallel recipes hook in here); batch shardings via `batch_sharding`.
     """
 
-    def __init__(self, model: Layer, loss_fn: Callable, optimizer, donate_state=True):
+    def __init__(self, model: Layer, loss_fn: Callable, optimizer, donate_state=True,
+                 return_outputs=False, split_label=False):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
+        # hapi metrics need the forward outputs: thread them out of the
+        # compiled step as an aux (costs an extra device->host copy per call)
+        self._return_outputs = return_outputs
+        # split_label=True: the LAST positional arg is always the label — for
+        # callers (hapi) that know, bypassing the forward-signature heuristic
+        # (which misbinds labels into optional forward params like mask=None)
+        self._split_label = split_label
         self._param_tensors = dict(model.state_dict())
         self._trainable = {
             k: t for k, t in self._param_tensors.items()
@@ -90,6 +98,7 @@ class TrainStep:
         loss_fn = self.loss_fn
         trainable_keys = list(self._trainable)
         param_tensors = self._param_tensors
+        return_outputs = self._return_outputs
         # map param name -> live Parameter object (ids stable across calls)
         inner_opt = getattr(opt, "_inner_opt", opt)
         stage = self._stage
@@ -107,7 +116,9 @@ class TrainStep:
             # GPTForCausalLM(input_ids, labels=...)); otherwise the last positional
             # arg is the label and goes to loss_fn (classifier + CrossEntropyLoss).
             model_args, label = args, None
-            if fwd_sig is not None:
+            if self._split_label:
+                model_args, label = args[:-1], args[-1]
+            elif fwd_sig is not None:
                 try:
                     fwd_sig.bind(model, *args, **kwargs)
                 except TypeError:
@@ -147,10 +158,16 @@ class TrainStep:
                     k: (v._value if isinstance(v, Tensor) else v)
                     for k, v in mutated.items() if k not in trainable_keys
                 }
-                return loss_v, buffers
+                outs = None
+                if return_outputs:
+                    outs = jax.tree.map(
+                        lambda t: (jax.lax.stop_gradient(t._value)
+                                   if isinstance(t, Tensor) else t),
+                        out, is_leaf=lambda t: isinstance(t, Tensor))
+                return loss_v, (buffers, outs)
 
             trainable_state = {k: state[k] for k in trainable_keys}
-            (loss_val, new_buffers), grads = jax.value_and_grad(
+            (loss_val, (new_buffers, fwd_outs)), grads = jax.value_and_grad(
                 loss_from, has_aux=True
             )(trainable_state)
             if stage is not None and stage.shard_grads:
@@ -222,6 +239,8 @@ class TrainStep:
                         ash = stage.acc_sharding(param_tensors[k], tuple(v.shape))
                         if ash is not None:
                             per[k] = jax.lax.with_sharding_constraint(v, ash)
+            if return_outputs:
+                return loss_val, new_state, new_acc, fwd_outs
             return loss_val, new_state, new_acc
 
         return jax.jit(step_fn, donate_argnums=(0, 1))
@@ -305,7 +324,11 @@ class TrainStep:
             # must fall back to the jitted path (which recompiles) not raise
             if self._arg_avals(args, kwargs) == self._compiled_avals:
                 fn = self._compiled
-        loss_val, new_state, new_acc = fn(*traced, args, kwargs)
+        result = fn(*traced, args, kwargs)
+        if self._return_outputs:
+            loss_val, new_state, new_acc, fwd_outs = result
+        else:
+            (loss_val, new_state, new_acc), fwd_outs = result, None
         # write back into live objects
         for k, t in self._param_tensors.items():
             t._value = new_state[k]
@@ -313,4 +336,7 @@ class TrainStep:
             store = inner_opt._accumulators.setdefault(acc_name, {})
             for k, v in per.items():
                 store[id(self._param_tensors[k])] = v
+        if self._return_outputs:
+            outs = jax.tree.map(Tensor, fwd_outs)
+            return Tensor(loss_val), outs
         return Tensor(loss_val)

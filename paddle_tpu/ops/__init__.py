@@ -82,9 +82,34 @@ def apply_op(fn, name: str, *args, nout: int | None = None, **kwargs):
 
     if not need_grad:
         out = fn(*vals, **kwargs)
+        _maybe_scan_nan_inf(name, out)
         return _wrap_outputs(out, stop_gradient=True)
 
     diff_idx = [i for i, a in enumerate(args) if _is_diffable(a)]
+
+    # ---------------- eager vjp cache (round-1/2 finding: per-op re-trace) ----
+    # Keyed on (fn code+cells, name, avals, kwargs): repeat eager steps hit two
+    # cached jit programs (fwd; rematerializing bwd) instead of re-tracing
+    # jax.vjp on every call. Tracer inputs and unhashable keys use the direct
+    # path below.
+    in_trace = _builtins.any(isinstance(v, jax.core.Tracer) for v in vals)
+    key = None if in_trace else _eager_key(fn, name, vals, tuple(diff_idx), kwargs)
+    if key is not None:
+        entry = _EAGER_CACHE.get(key)
+        if entry is _UNCACHEABLE:
+            key = None
+        elif entry is not None:
+            try:
+                return _run_cached(entry, name, args, vals, diff_idx, nout)
+            except (jax.errors.ConcretizationTypeError,
+                    jax.errors.TracerArrayConversionError,
+                    jax.errors.TracerIntegerConversionError,
+                    jax.errors.TracerBoolConversionError, TypeError):
+                # op is value-dependent (an input's VALUES drive output shape,
+                # e.g. repeat_interleave with a repeats tensor): jitting it is
+                # wrong — blacklist and use the direct path permanently
+                _EAGER_CACHE[key] = _UNCACHEABLE
+                key = None
 
     def closure(*diff_vals):
         merged = list(vals)
@@ -98,11 +123,156 @@ def apply_op(fn, name: str, *args, nout: int | None = None, **kwargs):
     primals = [vals[i] for i in diff_idx]
     out_tuple, vjp_fn, was_list = jax.vjp(closure, *primals, has_aux=True)
 
+    if key is not None:
+        _EAGER_CACHE[key] = _build_entry(fn, kwargs, vals, tuple(diff_idx),
+                                         was_list)
+
+    _maybe_scan_nan_inf(name, out_tuple)
     outputs = [Tensor(o, stop_gradient=False) for o in out_tuple]
     tape.record(vjp_fn, [args[i] for i in diff_idx], outputs, name=name)
     if len(outputs) == 1 and not was_list and nout is None:
         return outputs[0]
     return list(outputs) if was_list else tuple(outputs)
+
+
+_EAGER_CACHE: dict = {}
+_UNCACHEABLE = object()
+# python scalars stay STATIC (keyed by value): they are frequently structural
+# (shape dims, axes); arrays are traced, with the blacklist above as the escape
+# hatch for value-dependent ops
+_TRACED_TYPES = (jax.Array, np.ndarray, np.generic)
+
+
+def _cell_key(v, depth=0):
+    """Hashable stand-in for one closure cell value (None = give up)."""
+    if isinstance(v, (jax.Array, np.ndarray)):
+        return None  # data in a closure: unsafe to key on
+    if callable(v) and hasattr(v, "__code__") and depth < 2:
+        inner = tuple(
+            _cell_key(c.cell_contents, depth + 1) for c in (v.__closure__ or ())
+        )
+        if _builtins.any(c is None for c in inner):
+            return None
+        return (v.__code__, inner)
+    try:
+        hash(v)
+        return (type(v).__name__, v)
+    except TypeError:
+        if isinstance(v, (list, tuple)):
+            parts = tuple(_cell_key(e, depth + 1) for e in v)
+            return None if _builtins.any(p is None for p in parts) else parts
+        return None
+
+
+def _eager_key(fn, name, vals, diff_idx, kwargs):
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        # builtin / PjitFunction: key on the object itself (the cache entry
+        # keeps it alive, so identity is stable)
+        try:
+            hash(fn)
+        except TypeError:
+            return None
+        code, cells = fn, ()
+    else:
+        cells = tuple(_cell_key(c.cell_contents) for c in (fn.__closure__ or ()))
+        if _builtins.any(c is None for c in cells):
+            return None
+    sig = []
+    for v in vals:
+        if isinstance(v, (jax.Array, np.ndarray, np.generic)):
+            sig.append(("a", tuple(v.shape), str(v.dtype)))
+        elif type(v) in (float, int, bool, complex):
+            sig.append(("s", type(v).__name__, v))  # static: keyed by value
+        else:
+            k = _cell_key(v)
+            if k is None:
+                return None
+            sig.append(("c", k))
+    try:
+        kw = tuple(sorted((k, _cell_key(v)) for k, v in kwargs.items()))
+    except TypeError:
+        return None
+    if _builtins.any(v is None for _, v in kw):
+        return None
+    return (code, cells, name, tuple(sig), kw, diff_idx)
+
+
+def _build_entry(fn, kwargs, vals, diff_idx, was_list):
+    """Jitted fwd + rematerializing bwd specialized to this call signature."""
+    n = len(vals)
+    traced_pos = tuple(i for i, v in enumerate(vals)
+                       if isinstance(v, _TRACED_TYPES))
+    static_by_pos = {i: vals[i] for i in range(n) if i not in traced_pos}
+    diff_slots = tuple(traced_pos.index(i) for i in diff_idx)
+
+    def primal(traced_vals):
+        merged = []
+        ti = 0
+        for i in range(n):
+            if i in static_by_pos:
+                merged.append(static_by_pos[i])
+            else:
+                merged.append(traced_vals[ti])
+                ti += 1
+        out = fn(*merged, **kwargs)
+        return tuple(out) if isinstance(out, (tuple, list)) else (out,)
+
+    @jax.jit
+    def fwd(traced_vals):
+        return primal(traced_vals)
+
+    @jax.jit
+    def bwd(ct, traced_vals):
+        def diff_closure(*diff_vals):
+            tv = list(traced_vals)
+            for slot, v in zip(diff_slots, diff_vals):
+                tv[slot] = v
+            return primal(tv)
+
+        _, vjp_fn = jax.vjp(diff_closure,
+                            *[traced_vals[s] for s in diff_slots])
+        return vjp_fn(ct)
+
+    return (fwd, bwd, was_list, traced_pos)
+
+
+def _run_cached(entry, name, args, vals, diff_idx, nout):
+    fwd, bwd, was_list, traced_pos = entry
+    traced_vals = tuple(vals[i] for i in traced_pos)
+    out_tuple = fwd(traced_vals)
+    _maybe_scan_nan_inf(name, out_tuple)
+    outputs = [Tensor(o, stop_gradient=False) for o in out_tuple]
+    tape.record(lambda ct: bwd(ct, traced_vals),
+                [args[i] for i in diff_idx], outputs, name=name)
+    if len(outputs) == 1 and not was_list and nout is None:
+        return outputs[0]
+    return list(outputs) if was_list else tuple(outputs)
+
+
+def _maybe_scan_nan_inf(name, out):
+    """Per-op NaN/Inf scan (reference: FLAGS_check_nan_inf in
+    paddle/fluid/framework/details/nan_inf_utils; flags.cc). Eager-only: traced
+    values are skipped (the compiled path uses amp.check_numerics)."""
+    from ..framework.flags import flag
+
+    if not flag("FLAGS_check_nan_inf"):
+        return
+    leaves = out if isinstance(out, (tuple, list)) else [out]
+    for i, v in enumerate(leaves):
+        if isinstance(v, jax.core.Tracer) or not hasattr(v, "dtype"):
+            continue
+        if not jnp.issubdtype(v.dtype, jnp.floating):
+            continue
+        bad = int(jnp.sum(~jnp.isfinite(v)))
+        if bad:
+            msg = f"op {name!r} output {i} contains {bad} NaN/Inf values"
+            if flag("FLAGS_check_nan_inf_level") >= 1:
+                import warnings
+
+                warnings.warn(msg)
+            else:
+                raise FloatingPointError(msg)
 
 
 def _wrap_outputs(out, stop_gradient=True):

@@ -1,0 +1,209 @@
+"""paddle.sparse — COO/CSR tensors and sparse ops.
+
+Reference: python/paddle/sparse/__init__.py (sparse_coo_tensor,
+sparse_csr_tensor, unary/binary ops, matmul). TPU-native backend:
+jax.experimental.sparse.BCOO — XLA compiles its gather/scatter kernels, and
+BCOO matmul lowers to segment-sum matmuls that run on the MXU. CSR is kept as
+a view format (crows/cols/values) converting through COO, matching how the
+reference treats CSR on non-CPU backends.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..tensor import Tensor
+
+
+def _val(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x)
+
+
+class SparseCooTensor:
+    """COO sparse tensor: indices [sparse_ndim, nnz] + values [nnz, ...]."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._bcoo = bcoo
+
+    # --------------------------------------------------------------- properties
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    @property
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def indices(self):
+        return Tensor(self._bcoo.indices.T)  # paddle layout [ndim, nnz]
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    # --------------------------------------------------------------- conversions
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def to_sparse_csr(self):
+        dense_shape = self._bcoo.shape
+        if len(dense_shape) != 2:
+            raise ValueError("CSR requires a 2-D tensor")
+        coo = self.coalesce()
+        idx = np.asarray(coo._bcoo.indices)
+        vals = coo._bcoo.data
+        order = np.lexsort((idx[:, 1], idx[:, 0]))
+        rows, cols = idx[order, 0], idx[order, 1]
+        crows = np.zeros(dense_shape[0] + 1, dtype=np.int64)
+        np.add.at(crows[1:], rows, 1)
+        crows = np.cumsum(crows)
+        return SparseCsrTensor(
+            Tensor(jnp.asarray(crows)), Tensor(jnp.asarray(cols)),
+            Tensor(vals[jnp.asarray(order)]), dense_shape)
+
+    def coalesce(self):
+        return SparseCooTensor(self._bcoo.sum_duplicates())
+
+    # --------------------------------------------------------------- ops
+    def __add__(self, other):
+        if isinstance(other, SparseCooTensor):
+            return SparseCooTensor(
+                jsparse.BCOO.fromdense(self._bcoo.todense() + other._bcoo.todense()))
+        return Tensor(self._bcoo.todense() + _val(other))
+
+    def __matmul__(self, other):
+        return matmul(self, other)
+
+    def transpose(self, perm=(1, 0)):
+        return SparseCooTensor(self._bcoo.transpose(tuple(perm)))
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    def __init__(self, crows, cols, values, shape):
+        self._crows, self._cols, self._values = crows, cols, values
+        self._shape = tuple(shape)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def nnz(self):
+        return int(self._values.shape[0])
+
+    def crows(self):
+        return self._crows
+
+    def cols(self):
+        return self._cols
+
+    def values(self):
+        return self._values
+
+    def to_sparse_coo(self, sparse_dim=2):
+        crows = np.asarray(self._crows._value)
+        rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+        idx = jnp.stack([jnp.asarray(rows), self._cols._value], axis=1)
+        bcoo = jsparse.BCOO((self._values._value, idx), shape=self._shape)
+        return SparseCooTensor(bcoo)
+
+    def to_dense(self):
+        return self.to_sparse_coo().to_dense()
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+# ------------------------------------------------------------------ constructors
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    """Reference: sparse/creation.py:sparse_coo_tensor. indices [ndim, nnz]."""
+    idx = np.asarray(_val(indices)).T  # BCOO wants [nnz, ndim]
+    vals = _val(values)
+    if dtype is not None:
+        vals = vals.astype(dtype)
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in idx.max(0)) + tuple(vals.shape[1:])
+    bcoo = jsparse.BCOO((vals, jnp.asarray(idx)), shape=tuple(shape))
+    return SparseCooTensor(bcoo)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None):
+    vals = _val(values)
+    if dtype is not None:
+        vals = vals.astype(dtype)
+    return SparseCsrTensor(Tensor(_val(crows)), Tensor(_val(cols)),
+                           Tensor(vals), shape)
+
+
+def to_sparse_coo(x, sparse_dim=None):
+    return SparseCooTensor(jsparse.BCOO.fromdense(_val(x)))
+
+
+# ------------------------------------------------------------------ functional
+def matmul(a, b):
+    """sparse @ dense (and sparse @ sparse via densify of b)."""
+    if isinstance(a, SparseCooTensor):
+        bv = b._bcoo.todense() if isinstance(b, SparseCooTensor) else _val(b)
+        return Tensor(a._bcoo @ bv)
+    if isinstance(a, SparseCsrTensor):
+        return matmul(a.to_sparse_coo(), b)
+    raise TypeError("matmul: first operand must be sparse")
+
+
+def add(a, b):
+    return a + b
+
+
+def _unary(name, jfn, domain_preserving=True):
+    def fn(x):
+        if isinstance(x, SparseCooTensor):
+            # zero-preserving unary ops act on stored values only
+            b = x._bcoo
+            return SparseCooTensor(jsparse.BCOO((jfn(b.data), b.indices),
+                                                shape=b.shape))
+        if isinstance(x, SparseCsrTensor):
+            return SparseCsrTensor(x._crows, x._cols,
+                                   Tensor(jfn(x._values._value)), x._shape)
+        return Tensor(jfn(_val(x)))
+
+    fn.__name__ = name
+    return fn
+
+
+relu = _unary("relu", lambda v: jnp.maximum(v, 0))
+abs = _unary("abs", jnp.abs)
+neg = _unary("neg", jnp.negative)
+sin = _unary("sin", jnp.sin)
+tanh = _unary("tanh", jnp.tanh)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+pow = None  # placeholder overwritten below
+
+
+def pow(x, factor):  # noqa: F811
+    if isinstance(x, SparseCooTensor):
+        b = x._bcoo
+        return SparseCooTensor(jsparse.BCOO((jnp.power(b.data, factor), b.indices),
+                                            shape=b.shape))
+    return Tensor(jnp.power(_val(x), factor))
+
+
+def is_same_shape(a, b):
+    return tuple(a.shape) == tuple(b.shape)

@@ -1,4 +1,5 @@
 """paddle.vision. Reference: python/paddle/vision/."""
+from . import datasets  # noqa: F401
 from . import models  # noqa: F401
 from . import ops  # noqa: F401
 from . import transforms  # noqa: F401

@@ -1,0 +1,215 @@
+"""paddle.vision.datasets — dataset parsers for the standard vision corpora.
+
+Reference: python/paddle/vision/datasets/ (MNIST idx-format parser mnist.py:190,
+CIFAR tar-of-pickles cifar.py, folder.py DatasetFolder/ImageFolder). This
+environment has zero network egress, so ``download=True`` raises with
+instructions; all parsers consume local files in the exact upstream formats
+(tests synthesize them). Decoding is numpy-only — no PIL dependency; the
+'cv2'/'pil' backend knobs map to numpy HWC arrays.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder",
+           "ImageFolder"]
+
+_NO_EGRESS = ("this build has no network egress: pass image_path/label_path "
+              "(or data_file) pointing at already-downloaded files instead of "
+              "download=True")
+
+
+def _maybe_open(path):
+    return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
+
+
+class MNIST(Dataset):
+    """idx-format parser (reference mnist.py:190 _parse_dataset).
+
+    ``image_path``/``label_path``: local idx3-ubyte / idx1-ubyte files
+    (optionally .gz). mode: 'train' | 'test' (used only for default names).
+    """
+
+    NAME = "mnist"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        if image_path is None or label_path is None:
+            if download:
+                raise RuntimeError(_NO_EGRESS)
+            raise ValueError("MNIST needs image_path and label_path "
+                             f"({_NO_EGRESS})")
+        self.mode = mode
+        self.transform = transform
+        self.images = self._parse_images(image_path)
+        self.labels = self._parse_labels(label_path)
+        assert len(self.images) == len(self.labels), "image/label count mismatch"
+
+    @staticmethod
+    def _parse_images(path):
+        with _maybe_open(path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            if magic != 2051:
+                raise ValueError(f"bad idx3 magic {magic:#x} in {path}")
+            buf = f.read(n * rows * cols)
+        return np.frombuffer(buf, dtype=np.uint8).reshape(n, rows, cols)
+
+    @staticmethod
+    def _parse_labels(path):
+        with _maybe_open(path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            if magic != 2049:
+                raise ValueError(f"bad idx1 magic {magic:#x} in {path}")
+            buf = f.read(n)
+        return np.frombuffer(buf, dtype=np.uint8).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype("float32")[..., None]  # HWC
+        label = np.array([self.labels[idx]], dtype=np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"
+
+
+class Cifar10(Dataset):
+    """CIFAR tar-of-pickled-batches parser (reference cifar.py).
+
+    ``data_file``: local cifar-10-python.tar.gz (or an uncompressed .tar).
+    """
+
+    _META = {"batches": ["data_batch_1", "data_batch_2", "data_batch_3",
+                         "data_batch_4", "data_batch_5"],
+             "test": ["test_batch"], "label_key": b"labels"}
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if data_file is None:
+            if download:
+                raise RuntimeError(_NO_EGRESS)
+            raise ValueError(f"Cifar needs data_file ({_NO_EGRESS})")
+        self.mode = mode
+        self.transform = transform
+        names = self._META["batches"] if mode == "train" else self._META["test"]
+        datas, labels = [], []
+        with tarfile.open(data_file, "r:*") as tf:
+            for member in tf.getmembers():
+                base = os.path.basename(member.name)
+                if base in names:
+                    d = pickle.load(tf.extractfile(member), encoding="bytes")
+                    datas.append(d[b"data"])
+                    labels.extend(d[self._META["label_key"]])
+        if not datas:
+            raise ValueError(f"no {names} members found in {data_file}")
+        self.data = np.concatenate(datas, 0)
+        self.labels = np.asarray(labels, dtype=np.int64)
+
+    def __getitem__(self, idx):
+        img = self.data[idx].reshape(3, 32, 32).transpose(1, 2, 0).astype("float32")
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.array([self.labels[idx]], dtype=np.int64)
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Cifar100(Cifar10):
+    _META = {"batches": ["train"], "test": ["test"], "label_key": b"fine_labels"}
+
+
+IMG_EXTENSIONS = (".npy", ".png", ".jpg", ".jpeg", ".bmp", ".ppm")
+
+
+def _load_image(path):
+    """numpy-backed loader: .npy natively; PNG/JPEG via PIL if available."""
+    if path.endswith(".npy"):
+        return np.load(path)
+    try:
+        from PIL import Image
+
+        return np.asarray(Image.open(path).convert("RGB"))
+    except ImportError as e:
+        raise ImportError(
+            f"decoding {path!r} needs PIL; use .npy images in this build") from e
+
+
+class DatasetFolder(Dataset):
+    """class-per-subdirectory layout (reference folder.py:DatasetFolder)."""
+
+    def __init__(self, root, loader=None, extensions=IMG_EXTENSIONS,
+                 transform=None, is_valid_file=None):
+        self.root = root
+        self.loader = loader or _load_image
+        self.transform = transform
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise ValueError(f"no class directories under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for fname in sorted(files):
+                    p = os.path.join(dirpath, fname)
+                    ok = (is_valid_file(p) if is_valid_file
+                          else p.lower().endswith(tuple(extensions)))
+                    if ok:
+                        self.samples.append((p, self.class_to_idx[c]))
+        if not self.samples:
+            raise ValueError(f"no valid samples under {root}")
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """flat folder of images, no labels (reference folder.py:ImageFolder)."""
+
+    def __init__(self, root, loader=None, extensions=IMG_EXTENSIONS,
+                 transform=None, is_valid_file=None):
+        self.root = root
+        self.loader = loader or _load_image
+        self.transform = transform
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fname in sorted(files):
+                p = os.path.join(dirpath, fname)
+                ok = (is_valid_file(p) if is_valid_file
+                      else p.lower().endswith(tuple(extensions)))
+                if ok:
+                    self.samples.append(p)
+        if not self.samples:
+            raise ValueError(f"no valid images under {root}")
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)

@@ -31,6 +31,26 @@ def main():
         if int(os.environ.get("PADDLE_RESTART_ATTEMPT", "0")) == 0:
             os._exit(17)
 
+    if "--p2p" in sys.argv:
+        # cross-process eager send/recv over the control-plane store
+        payload = np.arange(6, dtype="float32").reshape(2, 3) * (rank + 1)
+        import paddle_tpu as paddle
+
+        if rank == 0:
+            dist.send(paddle.to_tensor(payload), dst=1)
+            dist.send(paddle.to_tensor(payload + 100), dst=1)
+        else:
+            t = paddle.to_tensor(np.zeros((2, 3), "float32"))
+            dist.recv(t, src=0)
+            assert np.allclose(np.asarray(t._value),
+                               np.arange(6, dtype="float32").reshape(2, 3)), t._value
+            dist.recv(t, src=0)
+            assert np.allclose(np.asarray(t._value),
+                               np.arange(6, dtype="float32").reshape(2, 3) + 100)
+        from paddle_tpu.distributed.env import _store
+        _store.barrier("p2p_done", world, timeout=60)
+        return
+
     mesh = Mesh(np.array(jax.devices()), ("x",))
     local = jnp.ones((1, 4)) * (rank + 1)
     garr = jax.make_array_from_single_device_arrays(

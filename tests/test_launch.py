@@ -139,6 +139,14 @@ def test_launch_restart_on_failure(tmp_path):
     assert r.returncode == 0, (r.stdout, r.stderr, logs)
 
 
+def test_launch_cross_process_send_recv(tmp_path):
+    """Eager p2p rides the control-plane store between launched processes."""
+    r = _run_launch(["--nproc_per_node", "2", "--log_dir", str(tmp_path)],
+                    worker_args=("--p2p",))
+    logs = _read_results(tmp_path, 2)
+    assert r.returncode == 0, (r.stdout, r.stderr, logs)
+
+
 def test_multinode_restart_coordination(tmp_path):
     """Two controllers (nnodes=2) share one store: a failure on node 1 must
     restart BOTH pods in lockstep, and the job completes on attempt 1.

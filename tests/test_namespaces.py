@@ -1,0 +1,205 @@
+"""fft / sparse / flags / vision.datasets namespace tests (VERDICT missing #9/#10)."""
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+# ------------------------------------------------------------------ fft
+def test_fft_roundtrip_and_parity():
+    x = np.random.default_rng(0).standard_normal(16).astype("float32")
+    got = paddle.fft.fft(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, np.fft.fft(x), rtol=1e-4, atol=1e-4)
+    back = paddle.fft.ifft(paddle.fft.fft(paddle.to_tensor(x))).numpy()
+    np.testing.assert_allclose(back.real, x, rtol=1e-4, atol=1e-4)
+
+
+def test_rfft_and_freq():
+    x = np.random.default_rng(1).standard_normal((4, 8)).astype("float32")
+    got = paddle.fft.rfft(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, np.fft.rfft(x), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(paddle.fft.rfftfreq(8, d=0.5).numpy(),
+                               np.fft.rfftfreq(8, 0.5), rtol=1e-6)
+
+
+def test_fft2_and_shift():
+    x = np.random.default_rng(2).standard_normal((4, 4)).astype("float32")
+    got = paddle.fft.fft2(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, np.fft.fft2(x), rtol=1e-4, atol=1e-4)
+    sh = paddle.fft.fftshift(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(sh, np.fft.fftshift(x), rtol=1e-6)
+
+
+def test_fft_grad_flows():
+    x = paddle.to_tensor(np.random.default_rng(3).standard_normal(8).astype("float32"),
+                         stop_gradient=False)
+    y = paddle.fft.rfft(x)
+    loss = (y.real() ** 2 + y.imag() ** 2).sum() if hasattr(y, "real") else None
+    # fall back to abs if complex methods unavailable
+    if loss is None:
+        loss = paddle.abs(y).sum()
+    loss.backward()
+    assert x.grad is not None
+
+
+# ------------------------------------------------------------------ flags
+def test_flags_roundtrip_and_unknown():
+    flags = paddle.get_flags()
+    assert "FLAGS_check_nan_inf" in flags
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    assert paddle.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"] is True
+    paddle.set_flags({"FLAGS_check_nan_inf": False})
+    with pytest.raises(ValueError):
+        paddle.set_flags({"FLAGS_definitely_not_a_flag": 1})
+    with pytest.raises(ValueError):
+        paddle.get_flags("FLAGS_definitely_not_a_flag")
+
+
+def test_nan_inf_scan_catches_bad_op():
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        with pytest.raises(FloatingPointError, match="NaN/Inf"):
+            paddle.log(paddle.to_tensor(np.array([-1.0], "float32")))
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+    # flag off: no error
+    paddle.log(paddle.to_tensor(np.array([-1.0], "float32")))
+
+
+# ------------------------------------------------------------------ sparse
+def test_sparse_coo_roundtrip():
+    indices = [[0, 1, 2], [1, 2, 0]]
+    values = [1.0, 2.0, 3.0]
+    s = paddle.sparse.sparse_coo_tensor(indices, values, shape=[3, 3])
+    dense = s.to_dense().numpy()
+    want = np.zeros((3, 3), "float32")
+    want[0, 1], want[1, 2], want[2, 0] = 1, 2, 3
+    np.testing.assert_allclose(dense, want)
+    assert s.nnz == 3
+    np.testing.assert_array_equal(np.asarray(s.indices().numpy()), indices)
+
+
+def test_sparse_matmul_matches_dense():
+    rng = np.random.default_rng(4)
+    dense = rng.standard_normal((4, 5)).astype("float32")
+    dense[dense < 0.3] = 0
+    s = paddle.sparse.to_sparse_coo(dense)
+    b = rng.standard_normal((5, 3)).astype("float32")
+    got = paddle.sparse.matmul(s, paddle.to_tensor(b)).numpy()
+    np.testing.assert_allclose(got, dense @ b, rtol=1e-5, atol=1e-5)
+
+
+def test_sparse_csr_conversion():
+    dense = np.array([[1.0, 0, 2.0], [0, 0, 3.0]], "float32")
+    coo = paddle.sparse.to_sparse_coo(dense)
+    csr = coo.to_sparse_csr()
+    np.testing.assert_array_equal(np.asarray(csr.crows().numpy()), [0, 2, 3])
+    np.testing.assert_array_equal(np.asarray(csr.cols().numpy()), [0, 2, 2])
+    np.testing.assert_allclose(csr.to_dense().numpy(), dense)
+
+
+def test_sparse_unary_zero_preserving():
+    dense = np.array([[-1.0, 0.0], [0.0, 4.0]], "float32")
+    s = paddle.sparse.to_sparse_coo(dense)
+    np.testing.assert_allclose(paddle.sparse.relu(s).to_dense().numpy(),
+                               np.maximum(dense, 0))
+    np.testing.assert_allclose(paddle.sparse.abs(s).to_dense().numpy(),
+                               np.abs(dense))
+
+
+# ------------------------------------------------------------------ datasets
+def _write_mnist(tmp, n=10, gz=False):
+    imgs = np.random.default_rng(0).integers(0, 256, (n, 28, 28)).astype(np.uint8)
+    labels = np.random.default_rng(1).integers(0, 10, n).astype(np.uint8)
+    ip, lp = os.path.join(tmp, "im.idx3"), os.path.join(tmp, "lb.idx1")
+    if gz:
+        ip, lp = ip + ".gz", lp + ".gz"
+    opener = gzip.open if gz else open
+    with opener(ip, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28))
+        f.write(imgs.tobytes())
+    with opener(lp, "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(labels.tobytes())
+    return ip, lp, imgs, labels
+
+
+@pytest.mark.parametrize("gz", [False, True])
+def test_mnist_parser(tmp_path, gz):
+    ip, lp, imgs, labels = _write_mnist(str(tmp_path), gz=gz)
+    ds = paddle.vision.datasets.MNIST(image_path=ip, label_path=lp)
+    assert len(ds) == 10
+    img, lab = ds[3]
+    assert img.shape == (28, 28, 1)
+    np.testing.assert_allclose(img[..., 0], imgs[3].astype("float32"))
+    assert lab[0] == labels[3]
+
+
+def test_mnist_requires_paths():
+    with pytest.raises(RuntimeError, match="egress"):
+        paddle.vision.datasets.MNIST(download=True)
+    with pytest.raises(ValueError):
+        paddle.vision.datasets.MNIST()
+
+
+def test_cifar10_parser(tmp_path):
+    rng = np.random.default_rng(2)
+    tar_path = str(tmp_path / "cifar-10-python.tar.gz")
+    batches = {}
+    for name in ["data_batch_1", "data_batch_2", "test_batch"]:
+        batches[name] = {
+            b"data": rng.integers(0, 256, (5, 3072)).astype(np.uint8),
+            b"labels": rng.integers(0, 10, 5).tolist(),
+        }
+    with tarfile.open(tar_path, "w:gz") as tf:
+        for name, d in batches.items():
+            import io as _io
+
+            blob = pickle.dumps(d)
+            info = tarfile.TarInfo(f"cifar-10-batches-py/{name}")
+            info.size = len(blob)
+            tf.addfile(info, _io.BytesIO(blob))
+    train = paddle.vision.datasets.Cifar10(data_file=tar_path, mode="train")
+    test = paddle.vision.datasets.Cifar10(data_file=tar_path, mode="test")
+    assert len(train) == 10 and len(test) == 5
+    img, lab = train[0]
+    assert img.shape == (32, 32, 3)
+    np.testing.assert_allclose(
+        img, batches["data_batch_1"][b"data"][0].reshape(3, 32, 32)
+        .transpose(1, 2, 0).astype("float32"))
+
+
+def test_dataset_folder_and_image_folder(tmp_path):
+    for cls in ["cat", "dog"]:
+        d = tmp_path / "root" / cls
+        d.mkdir(parents=True)
+        for i in range(3):
+            np.save(d / f"{i}.npy",
+                    np.random.default_rng(i).standard_normal((4, 4, 3)))
+    ds = paddle.vision.datasets.DatasetFolder(str(tmp_path / "root"))
+    assert ds.classes == ["cat", "dog"]
+    assert len(ds) == 6
+    img, target = ds[0]
+    assert img.shape == (4, 4, 3) and target == 0
+    assert ds[5][1] == 1
+
+    flat = paddle.vision.datasets.ImageFolder(str(tmp_path / "root"))
+    assert len(flat) == 6
+    assert flat[0][0].shape == (4, 4, 3)
+
+
+def test_dataset_with_dataloader(tmp_path):
+    ip, lp, _, _ = _write_mnist(str(tmp_path))
+    ds = paddle.vision.datasets.MNIST(image_path=ip, label_path=lp)
+    loader = paddle.io.DataLoader(ds, batch_size=4, drop_last=True)
+    batches = list(loader)
+    assert len(batches) == 2
+    xb, yb = batches[0]
+    assert tuple(xb.shape) == (4, 28, 28, 1)
+    assert tuple(yb.shape) == (4, 1)
