@@ -17,6 +17,7 @@ from .mesh import (
     ProcessMesh,
     Replicate,
     Shard,
+    get_mesh,
     sharding_for,
     spec_for,
 )
@@ -280,8 +281,60 @@ def shard_optimizer(optimizer, shard_fn=None):
 
 
 def shard_scaler(scaler):
+    """Reference api.py shard_scaler: make GradScaler found-inf detection span
+    the mesh. TPU-native: grads are global arrays, so jnp.isfinite already sees
+    every shard — install a hook only to mark the scaler mesh-aware (the
+    reference needs an allreduce here; GSPMD's reduction is the allreduce)."""
+    scaler._mesh = get_mesh()
     return scaler
 
 
-def shard_dataloader(dataloader, meshes=None, shard_dims=None, is_dataset_splitted=False):
-    return dataloader
+class _ShardedDataLoader:
+    """Iterates the inner loader and places each batch with its leading axis
+    sharded over `shard_dims` of `mesh` (reference auto_parallel shard_dataloader:
+    each rank reads its slice; single-process TPU: one process owns the global
+    batch and lays it out across devices)."""
+
+    def __init__(self, loader, mesh, shard_dims):
+        self._loader = loader
+        self._mesh = mesh
+        self._dims = shard_dims
+
+    def __len__(self):
+        return len(self._loader)
+
+    def __iter__(self):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from ..tensor import Tensor as _T
+
+        jm = self._mesh.jax_mesh
+        dim = self._dims if isinstance(self._dims, str) else (
+            self._dims[0] if self._dims else self._mesh.dim_names[0])
+
+        def place(x):
+            v = x._value if isinstance(x, _T) else None
+            if v is None or v.ndim == 0:
+                return x
+            n = self._mesh.get_dim_size(dim)
+            if n <= 1 or v.shape[0] % n != 0:
+                return x
+            return _T(jax.device_put(
+                v, NamedSharding(jm, PartitionSpec(dim))),
+                stop_gradient=x.stop_gradient)
+
+        for batch in self._loader:
+            if isinstance(batch, (list, tuple)):
+                yield type(batch)(place(b) for b in batch)
+            else:
+                yield place(batch)
+
+
+def shard_dataloader(dataloader, meshes=None, shard_dims=None,
+                     is_dataset_splitted=False):
+    mesh = meshes[0] if isinstance(meshes, (list, tuple)) and meshes else (
+        meshes or get_mesh())
+    if mesh is None:
+        return dataloader
+    return _ShardedDataLoader(dataloader, mesh, shard_dims)

@@ -1,15 +1,29 @@
 """DataParallel wrapper. Reference: python/paddle/distributed/parallel.py:219 +
 C++ Reducer (paddle/fluid/imperative/reducer.h:129).
 
-TPU-native: DP is a layout, not a wrapper — shard the batch axis over the 'dp' mesh axis
-and GSPMD turns the gradient sum into an all-reduce over ICI. This class exists for API
-parity: it shards parameters replicated over the mesh and (in the compiled path) relies
-on XLA for gradient sync; in single-process eager it is an identity wrapper.
+TPU-native: DP is a LAYOUT, not a gradient hook. The wrapper shards each
+input's batch axis over a 'dp' mesh spanning all visible devices; from there
+computation follows sharding — XLA partitions the forward, and the parameter
+gradients (a sum over the global batch) come out of the vjp with the
+cross-device reduction compiled in. That is exactly the work the reference's
+C++ Reducer does with bucketed allreduces, done instead by GSPMD. Consequences
+faithful to the reference API:
+
+- ``scale_loss`` is identity: the loss mean already spans the global batch.
+- ``no_sync`` is identity: there is no per-step allreduce to skip — gradient
+  accumulation composes naturally (grads of sharded-batch losses add).
+- ``apply_collective_grads`` is a no-op for the same reason.
 """
 from __future__ import annotations
 
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
 from ..nn.layer import Layer
+from ..tensor import Tensor
 from .env import ParallelEnv, get_rank, get_world_size, init_parallel_env  # noqa: F401
+from .mesh import get_mesh
 
 
 class DataParallel(Layer):
@@ -18,15 +32,63 @@ class DataParallel(Layer):
         super().__init__()
         self._layers = layers
         self.find_unused_parameters = find_unused_parameters
+        self._mesh = None
+        self._axis = None
+        mesh = get_mesh()
+        if mesh is not None and "dp" in mesh.dim_names:
+            self._mesh = mesh.jax_mesh
+            self._axis = "dp"
+        else:
+            devs = np.array(jax.devices())
+            if devs.size > 1:
+                self._mesh = Mesh(devs, ("dp",))
+                self._axis = "dp"
+
+    def _shard_batch(self, x):
+        """Place an input with its leading axis split over the dp mesh."""
+        if self._mesh is None:
+            return x
+        val = x._value if isinstance(x, Tensor) else None
+        if val is None or isinstance(val, jax.core.Tracer) or val.ndim == 0:
+            return x
+        ndev = self._mesh.devices.size
+        if val.shape[0] % ndev != 0:
+            return x  # indivisible batch: leave replicated (still correct)
+        sharded = jax.device_put(
+            val, NamedSharding(self._mesh, PartitionSpec(self._axis)))
+        out = Tensor(sharded, stop_gradient=x.stop_gradient)
+        out._grad_node = x._grad_node
+        out._grad_index = x._grad_index
+        return out
 
     def forward(self, *inputs, **kwargs):
+        inputs = tuple(self._shard_batch(x) for x in inputs)
         return self._layers(*inputs, **kwargs)
 
+    # ------------------------------------------------------------- passthroughs
     def state_dict(self, *args, **kwargs):
         return self._layers.state_dict(*args, **kwargs)
 
     def set_state_dict(self, state_dict, *args, **kwargs):
         return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def sublayers(self, include_self=False):
+        out = [self] if include_self else []
+        return out + self._layers.sublayers(include_self=True)
+
+    def train(self):
+        self._layers.train()
+        return super().train()
+
+    def eval(self):
+        self._layers.eval()
+        return super().eval()
 
     def scale_loss(self, loss):
         return loss

@@ -109,7 +109,12 @@ def _fwd_kernel(*refs, scale, causal, block_q, seq, has_sri):
     # Rows with no allowed position (possible under flashmask encodings) must
     # output exactly zero, not the uniform mean of V; lse=0 for such rows makes
     # backward's p = exp(_NEG - 0) = 0 so no gradient leaks through them.
-    any_allowed = jnp.any(allowed, axis=1, keepdims=True)
+    # NOT jnp.any: Mosaic lowers bool reduce_or via a float conversion in the
+    # DEFAULT float dtype — f64 under jax_enable_x64 (which paddle_tpu sets
+    # globally), and f64 vector reductions don't exist on TPU. An explicit f32
+    # max-reduce lowers cleanly regardless of the x64 setting.
+    any_allowed = jnp.max(allowed.astype(jnp.float32), axis=1,
+                          keepdims=True) > jnp.float32(0.0)
     o = jnp.where(any_allowed, o / l, jnp.float32(0.0))
     o_ref[0] = o.astype(o_ref.dtype)
     lse_ref[0] = jnp.where(any_allowed, m + jnp.log(l), jnp.float32(0.0))
