@@ -93,9 +93,12 @@ def _fwd_kernel(*refs, scale, causal, block_q, seq, has_sri):
         q_ref, k_ref, v_ref, o_ref, lse_ref = refs
         sri = None
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
+    # matmul INPUTS stay in the storage dtype (bf16 under AMP): the MXU runs
+    # bf16×bf16→f32 at full rate, f32×f32 at half. Softmax statistics are f32
+    # via preferred_element_type — the standard flash-attention precision split.
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
     rows, cols = _row_col(qi, block_q, seq)
@@ -104,7 +107,7 @@ def _fwd_kernel(*refs, scale, causal, block_q, seq, has_sri):
     m = jnp.max(s, axis=1, keepdims=True)
     e = jnp.exp(s - m)
     l = jnp.sum(e, axis=1, keepdims=True)
-    o = jax.lax.dot_general(e, v, (((1,), (0,)), ((), ())),
+    o = jax.lax.dot_general(e.astype(v.dtype), v, (((1,), (0,)), ((), ())),
                             preferred_element_type=jnp.float32)
     # Rows with no allowed position (possible under flashmask encodings) must
     # output exactly zero, not the uniform mean of V; lse=0 for such rows makes
@@ -167,10 +170,11 @@ def _dq_kernel(*refs, scale, causal, block_q, seq, has_sri):
         q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref = refs
         sri = None
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
+    # bf16 matmul inputs, f32 accumulation/statistics (see _fwd_kernel note)
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0]
     lse = lse_ref[0]    # (BQ, 1)
     delta = dl_ref[0]   # (BQ, 1)
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -182,7 +186,7 @@ def _dq_kernel(*refs, scale, causal, block_q, seq, has_sri):
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
     ds = p * (dp - delta) * scale
-    dq = jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+    dq = jax.lax.dot_general(ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
                              preferred_element_type=jnp.float32)
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
@@ -196,10 +200,11 @@ def _dkv_kernel(*refs, scale, causal, block_k, seq, has_sri):
         q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dk_ref, dv_ref = refs
         sri_blk = None
     ki = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)      # (S, D)
-    k = k_ref[0].astype(jnp.float32)      # (BK, D)
-    v = v_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)    # (S, D)
+    # bf16 matmul inputs, f32 accumulation/statistics (see _fwd_kernel note)
+    q = q_ref[0]                          # (S, D)
+    k = k_ref[0]                          # (BK, D)
+    v = v_ref[0]
+    do = do_ref[0]                        # (S, D)
     lse = lse_ref[0]                      # (S, 1)
     delta = dl_ref[0]
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -209,12 +214,12 @@ def _dkv_kernel(*refs, scale, causal, block_k, seq, has_sri):
     allowed = _allowed_mask(rows, cols, sri_blk, causal, seq)
     s = jnp.where(allowed, s, jnp.float32(_NEG))
     p = jnp.exp(s - lse)
-    dv = jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+    dv = jax.lax.dot_general(p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
                              preferred_element_type=jnp.float32)  # (BK, D)
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)  # (S, BK)
     ds = p * (dp - delta) * scale
-    dk = jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+    dk = jax.lax.dot_general(ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
                              preferred_element_type=jnp.float32)  # (BK, D)
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
