@@ -1,0 +1,4 @@
+"""paddle.audio surface. Reference: python/paddle/audio/__init__.py."""
+from . import functional  # noqa: F401
+from . import features  # noqa: F401
+from .features import MFCC, LogMelSpectrogram, MelSpectrogram, Spectrogram  # noqa: F401

@@ -1,0 +1,108 @@
+"""paddle.audio.features — Spectrogram / MelSpectrogram / LogMelSpectrogram / MFCC.
+
+Reference: python/paddle/audio/features/layers.py. TPU path: framing is one
+strided gather, the STFT is a single batched rfft (XLA FFT), mel projection is
+a matmul on the MXU.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..nn.layer import Layer
+from ..tensor import Tensor
+from . import functional as AF
+
+
+def _frame(x, frame_length, hop_length, center=True, pad_mode="reflect"):
+    """x: [..., T] → [..., n_frames, frame_length]."""
+    if center:
+        pad = frame_length // 2
+        cfg = [(0, 0)] * (x.ndim - 1) + [(pad, pad)]
+        x = jnp.pad(x, cfg, mode=pad_mode)
+    t = x.shape[-1]
+    n_frames = 1 + (t - frame_length) // hop_length
+    idx = (jnp.arange(n_frames)[:, None] * hop_length
+           + jnp.arange(frame_length)[None, :])
+    return x[..., idx]
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        w = AF.get_window(window, self.win_length, dtype=dtype)._value
+        if self.win_length < n_fft:  # center-pad window to n_fft
+            lp = (n_fft - self.win_length) // 2
+            w = jnp.pad(w, (lp, n_fft - self.win_length - lp))
+        self._window = w
+
+    def forward(self, x):
+        v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        frames = _frame(v.astype(jnp.float32), self.n_fft, self.hop_length,
+                        self.center, self.pad_mode)
+        spec = jnp.fft.rfft(frames * self._window, axis=-1)
+        mag = jnp.abs(spec)
+        if self.power != 1.0:
+            mag = mag ** self.power
+        # paddle layout: [..., freq, time]
+        return Tensor(jnp.swapaxes(mag, -1, -2))
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                        power, center, pad_mode, dtype)
+        self.n_mels = n_mels
+        self._fbank = AF.compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm, dtype)._value
+
+    def forward(self, x):
+        spec = self._spectrogram(x)._value  # [..., freq, time]
+        mel = jnp.einsum("mf,...ft->...mt", self._fbank, spec)
+        return Tensor(mel)
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 ref_value=1.0, amin=1e-10, top_db=None, dtype="float32"):
+        super().__init__()
+        self._mel = MelSpectrogram(sr, n_fft, hop_length, win_length, window,
+                                   power, center, pad_mode, n_mels, f_min,
+                                   f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = self._mel(x)
+        return AF.power_to_db(mel, self.ref_value, self.amin, self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self._log_mel = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center, pad_mode,
+            n_mels, f_min, f_max, htk, norm, ref_value, amin, top_db, dtype)
+        self._dct = AF.create_dct(n_mfcc, n_mels, dtype=dtype)._value
+
+    def forward(self, x):
+        logmel = self._log_mel(x)._value  # [..., n_mels, time]
+        return Tensor(jnp.einsum("mk,...mt->...kt", self._dct, logmel))

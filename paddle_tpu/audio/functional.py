@@ -1,0 +1,143 @@
+"""paddle.audio.functional — DSP building blocks.
+
+Reference: python/paddle/audio/functional/functional.py:29 (hz_to_mel),
+:83 (mel_to_hz), :189 (compute_fbank_matrix), :262 (power_to_db), :306
+(create_dct), window functions in window.py. All math is jnp (XLA-compiled on
+TPU); spectrogram hot paths use paddle_tpu.fft (XLA FFT).
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def hz_to_mel(freq, htk=False):
+    scalar = not hasattr(freq, "shape") or getattr(freq, "ndim", 0) == 0
+    f = jnp.asarray(_val(freq), jnp.float32)
+    if htk:
+        out = 2595.0 * jnp.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mels = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = jnp.where(f >= min_log_hz,
+                        min_log_mel + jnp.log(jnp.maximum(f, 1e-10) / min_log_hz)
+                        / logstep, mels)
+    return float(out) if scalar and not isinstance(freq, Tensor) else Tensor(out)
+
+
+def mel_to_hz(mel, htk=False):
+    scalar = not hasattr(mel, "shape") or getattr(mel, "ndim", 0) == 0
+    m = jnp.asarray(_val(mel), jnp.float32)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        freqs = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = jnp.where(m >= min_log_mel,
+                        min_log_hz * jnp.exp(logstep * (m - min_log_mel)), freqs)
+    return float(out) if scalar and not isinstance(mel, Tensor) else Tensor(out)
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False, dtype="float32"):
+    low = _val(hz_to_mel(f_min, htk))
+    high = _val(hz_to_mel(f_max, htk))
+    low = float(low) if not isinstance(low, float) else low
+    high = float(high) if not isinstance(high, float) else high
+    mels = jnp.linspace(low, high, n_mels)
+    return Tensor(_val(mel_to_hz(Tensor(mels), htk)).astype(dtype))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    return Tensor(jnp.linspace(0, sr / 2, n_fft // 2 + 1).astype(dtype))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None, htk=False,
+                         norm="slaney", dtype="float32"):
+    """[n_mels, n_fft//2 + 1] mel filterbank (reference functional.py:189)."""
+    if f_max is None:
+        f_max = sr / 2.0
+    fftfreqs = _val(fft_frequencies(sr, n_fft))
+    melfreqs = _val(mel_frequencies(n_mels + 2, f_min, f_max, htk))
+    fdiff = jnp.diff(melfreqs)
+    ramps = melfreqs[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0.0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (melfreqs[2:n_mels + 2] - melfreqs[:n_mels])
+        weights = weights * enorm[:, None]
+    return Tensor(weights.astype(dtype))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    """10*log10(S/ref) clipped at top_db below the peak (reference :262)."""
+    s = jnp.asarray(_val(spect))
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, s))
+    log_spec = log_spec - 10.0 * jnp.log10(jnp.maximum(amin, ref_value))
+    if top_db is not None:
+        if top_db < 0:
+            raise ValueError("top_db must be non-negative")
+        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+    return Tensor(log_spec)
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """[n_mels, n_mfcc] DCT-II basis (reference :306)."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)[None, :]
+    dct = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm is None:
+        dct = dct * 2.0
+    else:
+        if norm != "ortho":
+            raise ValueError("norm must be 'ortho' or None")
+        dct = dct * jnp.where(k == 0, math.sqrt(1.0 / (4 * n_mels)),
+                              math.sqrt(1.0 / (2 * n_mels))) * 2.0
+    return Tensor(dct.astype(dtype))
+
+
+# ------------------------------------------------------------------ windows
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """hann/hamming/blackman/bartlett/kaiser(+beta)/gaussian(+std) windows."""
+    if isinstance(window, (tuple, list)):
+        name, *params = window
+    else:
+        name, params = window, []
+    n = win_length
+    # periodic (fftbins) windows divide by N, symmetric by N-1
+    denom = n if fftbins else max(n - 1, 1)
+    i = np.arange(n)
+    if name == "hann":
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * i / denom)
+    elif name == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * i / denom)
+    elif name == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * i / denom)
+             + 0.08 * np.cos(4 * np.pi * i / denom))
+    elif name == "bartlett":
+        w = 1.0 - np.abs(2.0 * i / denom - 1.0)
+    elif name == "kaiser":
+        beta = params[0] if params else 12.0
+        w = np.kaiser(n + (1 if fftbins else 0), beta)[:n]
+    elif name == "gaussian":
+        std = params[0] if params else 7.0
+        w = np.exp(-0.5 * ((i - (n - 1) / 2) / std) ** 2)
+    elif name in ("rect", "boxcar", "ones"):
+        w = np.ones(n)
+    else:
+        raise ValueError(f"unknown window {name!r}")
+    return Tensor(jnp.asarray(w.astype(dtype)))

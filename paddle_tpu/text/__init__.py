@@ -1,0 +1,4 @@
+"""paddle.text surface. Reference: python/paddle/text/__init__.py."""
+from . import datasets  # noqa: F401
+from .datasets import Imdb, Imikolov, UCIHousing  # noqa: F401
+from .viterbi_decode import ViterbiDecoder, viterbi_decode  # noqa: F401

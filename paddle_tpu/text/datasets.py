@@ -1,0 +1,140 @@
+"""paddle.text.datasets — parsers for the standard text corpora.
+
+Reference: python/paddle/text/datasets/ (uci_housing.py, imdb.py, imikolov.py).
+Zero-egress environment: ``download=True`` raises; parsers consume local files
+in the upstream formats (tests synthesize them).
+"""
+from __future__ import annotations
+
+import os
+import re
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+_NO_EGRESS = ("this build has no network egress: pass data_file pointing at an "
+              "already-downloaded copy instead of download=True")
+
+__all__ = ["UCIHousing", "Imdb", "Imikolov"]
+
+
+class UCIHousing(Dataset):
+    """Whitespace-separated 14-column housing data (reference uci_housing.py);
+    features are normalized with the training-split statistics."""
+
+    N_FEATURES = 13
+
+    def __init__(self, data_file=None, mode="train", download=False):
+        if data_file is None:
+            if download:
+                raise RuntimeError(_NO_EGRESS)
+            raise ValueError(f"UCIHousing needs data_file ({_NO_EGRESS})")
+        raw = np.loadtxt(data_file).astype("float32")
+        if raw.ndim == 1:
+            raw = raw.reshape(-1, self.N_FEATURES + 1)
+        # reference ratio: 80/20 train/test split after global normalization
+        feats, target = raw[:, :-1], raw[:, -1:]
+        mx, mn, avg = feats.max(0), feats.min(0), feats.mean(0)
+        feats = (feats - avg) / np.maximum(mx - mn, 1e-8)
+        split = int(len(raw) * 0.8)
+        if mode == "train":
+            self.data = np.concatenate([feats[:split], target[:split]], 1)
+        else:
+            self.data = np.concatenate([feats[split:], target[split:]], 1)
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:-1], row[-1:]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imdb(Dataset):
+    """IMDB sentiment: aclImdb tar with {train,test}/{pos,neg}/*.txt members
+    (reference imdb.py — same tar layout, same tokenizer regex)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150, download=False):
+        if data_file is None:
+            if download:
+                raise RuntimeError(_NO_EGRESS)
+            raise ValueError(f"Imdb needs data_file ({_NO_EGRESS})")
+        pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        tokenizer = re.compile(r"\w+")
+        docs, labels = [], []
+        freq: dict[str, int] = {}
+        with tarfile.open(data_file, "r:*") as tf:
+            for member in tf.getmembers():
+                m = pat.match(member.name)
+                if not m:
+                    continue
+                text = tf.extractfile(member).read().decode("utf-8", "ignore")
+                words = [w.lower() for w in tokenizer.findall(text)]
+                docs.append(words)
+                labels.append(0 if m.group(1) == "pos" else 1)
+                for w in words:
+                    freq[w] = freq.get(w, 0) + 1
+        # build word dict by frequency with cutoff (reference builds on train)
+        vocab = [w for w, c in sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+                 if c >= min(cutoff, max(freq.values(), default=0))]
+        if not vocab:
+            vocab = sorted(freq)
+        self.word_idx = {w: i for i, w in enumerate(vocab)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        self.docs = [np.asarray([self.word_idx.get(w, unk) for w in d],
+                                dtype=np.int64) for d in docs]
+        self.labels = np.asarray(labels, dtype=np.int64)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram dataset (reference imikolov.py): tar with
+    ./simple-examples/data/ptb.{train,valid}.txt, returns n-grams."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, download=False):
+        if data_file is None:
+            if download:
+                raise RuntimeError(_NO_EGRESS)
+            raise ValueError(f"Imikolov needs data_file ({_NO_EGRESS})")
+        split = "train" if mode == "train" else "valid"
+        lines = []
+        with tarfile.open(data_file, "r:*") as tf:
+            for member in tf.getmembers():
+                if member.name.endswith(f"ptb.{split}.txt"):
+                    data = tf.extractfile(member).read().decode()
+                    lines = [l.strip().split() for l in data.splitlines() if l.strip()]
+        freq: dict[str, int] = {}
+        for words in lines:
+            for w in words:
+                freq[w] = freq.get(w, 0) + 1
+        vocab = [w for w, c in sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+                 if c >= min_word_freq and w != "<unk>"]
+        self.word_idx = {w: i for i, w in enumerate(vocab)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        self.data = []
+        for words in lines:
+            ids = ([self.word_idx.get("<s>", unk)]
+                   + [self.word_idx.get(w, unk) for w in words]
+                   + [self.word_idx.get("<e>", unk)])
+            if data_type.upper() == "NGRAM":
+                for i in range(len(ids) - window_size + 1):
+                    self.data.append(np.asarray(ids[i:i + window_size],
+                                                dtype=np.int64))
+            else:  # SEQ
+                self.data.append(np.asarray(ids, dtype=np.int64))
+
+    def __getitem__(self, idx):
+        return tuple(self.data[idx])
+
+    def __len__(self):
+        return len(self.data)

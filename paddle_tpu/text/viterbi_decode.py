@@ -1,0 +1,80 @@
+"""Viterbi decoding. Reference: python/paddle/text/viterbi_decode.py:31.
+
+TPU-native: the time recursion is a lax.scan over the sequence axis (static
+trip count, no Python loop under jit); backtracking is a reverse scan over the
+recorded argmax pointers.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer import Layer
+from ..tensor import Tensor
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """potentials [B, T, N], transitions [N, N], lengths [B] →
+    (scores [B], paths [B, T])."""
+    pot = potentials._value if isinstance(potentials, Tensor) else jnp.asarray(potentials)
+    trans = (transition_params._value if isinstance(transition_params, Tensor)
+             else jnp.asarray(transition_params)).astype(pot.dtype)
+    lens = (lengths._value if isinstance(lengths, Tensor)
+            else jnp.asarray(lengths)).astype(jnp.int32)
+    B, T, N = pot.shape
+
+    if include_bos_eos_tag:
+        # last tag = BOS, second-to-last = EOS (reference convention)
+        bos, eos = N - 1, N - 2
+        alpha0 = pot[:, 0] + trans[bos][None, :]
+    else:
+        alpha0 = pot[:, 0]
+
+    def step(carry, t):
+        alpha, history_dummy = carry
+        # scores[b, i, j] = alpha[b, i] + trans[i, j] + pot[b, t, j]
+        scores = alpha[:, :, None] + trans[None, :, :]
+        best_prev = jnp.argmax(scores, axis=1)                 # [B, N]
+        best_score = jnp.max(scores, axis=1) + pot[:, t]
+        # sequences shorter than t keep their previous alpha (masked update)
+        active = (t < lens)[:, None]
+        new_alpha = jnp.where(active, best_score, alpha)
+        ptr = jnp.where(active, best_prev, jnp.arange(N)[None, :])
+        return (new_alpha, history_dummy), ptr
+
+    (alpha, _), ptrs = jax.lax.scan(
+        step, (alpha0, jnp.zeros((), jnp.int32)), jnp.arange(1, T))
+    # ptrs: [T-1, B, N]
+
+    if include_bos_eos_tag:
+        alpha = alpha + trans[:, eos][None, :]
+
+    last_tag = jnp.argmax(alpha, axis=1)                       # [B]
+    scores = jnp.max(alpha, axis=1)
+
+    def back(carry, t):
+        tag = carry
+        ptr_t = ptrs[t]                                        # [B, N]
+        prev = jnp.take_along_axis(ptr_t, tag[:, None], axis=1)[:, 0]
+        # positions beyond a sequence's length keep the same tag
+        prev = jnp.where(t + 1 < lens, prev, tag)
+        return prev, prev
+
+    _, rev_path = jax.lax.scan(back, last_tag, jnp.arange(T - 2, -1, -1))
+    path = jnp.concatenate(
+        [jnp.flip(rev_path, 0), last_tag[None, :]], axis=0).T  # [B, T]
+    return Tensor(scores), Tensor(path.astype(jnp.int64))
+
+
+class ViterbiDecoder(Layer):
+    """Reference viterbi_decode.py:110."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

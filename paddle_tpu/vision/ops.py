@@ -137,9 +137,61 @@ class RoIPool:
         return roi_pool(x, boxes, boxes_num, self.output_size, self.spatial_scale)
 
 
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Position-sensitive ROI pooling (reference ops.py psroi_pool / R-FCN).
+
+    x: [N, C, H, W] with C = out_channels * oh * ow; output bin (i, j) of each
+    ROI average-pools the spatial region of the bin FROM the channel group
+    dedicated to that bin position.
+    """
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def f(feat, rois, rois_num):
+        n_rois = rois.shape[0]
+        C = feat.shape[1]
+        out_c = C // (oh * ow)
+        H, W = feat.shape[2], feat.shape[3]
+        batch_idx = jnp.repeat(jnp.arange(rois_num.shape[0]), rois_num, axis=0,
+                               total_repeat_length=n_rois)
+        x1 = rois[:, 0] * spatial_scale
+        y1 = rois[:, 1] * spatial_scale
+        x2 = rois[:, 2] * spatial_scale
+        y2 = rois[:, 3] * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        # sample each bin on a fixed sub-grid (TPU-friendly static shapes)
+        sr = 2
+        ys = y1[:, None] + (jnp.arange(oh * sr) + 0.5) / (oh * sr) * rh[:, None]
+        xs = x1[:, None] + (jnp.arange(ow * sr) + 0.5) / (ow * sr) * rw[:, None]
+        yi = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, H - 1)
+        xi = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, W - 1)
+
+        # bin (i,j) pools its own sample rows/cols from its own channel group;
+        # selecting the diagonal over (bin, sample-bin) axes is a one-hot
+        # contraction — XLA fuses it into a gather
+        def per_roi(bi, yy, xx):
+            fmap = feat[bi].reshape(out_c, oh, ow, H, W)
+            sampled = fmap[:, :, :, yy, :][:, :, :, :, xx]
+            s = sampled.reshape(out_c, oh, ow, oh, sr, ow, sr)
+            s = s.mean(axis=(4, 6))                           # [out_c,oh,ow,oh,ow]
+            eye_h = jnp.eye(oh)
+            eye_w = jnp.eye(ow)
+            return jnp.einsum("cijkl,ik,jl->cij", s, eye_h, eye_w)
+
+        return jax.vmap(per_roi)(batch_idx, yi, xi)
+
+    return apply_op(f, "psroi_pool", x, boxes, boxes_num)
+
+
 class PSRoIPool:
     def __init__(self, output_size, spatial_scale=1.0):
-        raise NotImplementedError("PSRoIPool lands with the detection pass")
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size, self.spatial_scale)
 
 
 def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
@@ -219,8 +271,140 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
     return apply_op(f, "yolo_box", x, img_size)
 
 
-def yolo_loss(*args, **kwargs):
-    raise NotImplementedError("yolo_loss lands with the detection training pass")
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss (reference ops.py yolo_loss semantics).
+
+    x: [N, mask*(5+classes), H, W] head output. gt_box: [N, B, 4] in
+    (cx, cy, w, h), normalized to the input image. gt_label: [N, B] int.
+    Returns per-image loss [N]. Matching follows YOLOv3: a gt is assigned to
+    the anchor (across ALL anchors) with best IoU at the gt's cell; predictions
+    whose best-gt IoU exceeds ignore_thresh are excluded from the no-object
+    objectness loss.
+    """
+    na_all = len(anchors) // 2
+    mask = list(anchor_mask)
+    nm = len(mask)
+
+    def f(xv, gb, gl, gs):
+        n, c, h, w = xv.shape
+        an_all = jnp.asarray(np.asarray(anchors, np.float32).reshape(na_all, 2))
+        an = an_all[jnp.asarray(mask)]
+        pred = xv.reshape(n, nm, 5 + class_num, h, w)
+        tx, ty = pred[:, :, 0], pred[:, :, 1]
+        tw, th = pred[:, :, 2], pred[:, :, 3]
+        tobj = pred[:, :, 4]
+        tcls = pred[:, :, 5:]
+
+        stride = downsample_ratio
+        in_w, in_h = w * stride, h * stride
+        nb = gb.shape[1]
+        valid = (gb[:, :, 2] > 0) & (gb[:, :, 3] > 0)          # [N, B]
+
+        # --- anchor assignment: best-IoU anchor (shape-only, centered)
+        gw = gb[:, :, 2] * in_w
+        gh = gb[:, :, 3] * in_h
+        inter = (jnp.minimum(gw[..., None], an_all[None, None, :, 0])
+                 * jnp.minimum(gh[..., None], an_all[None, None, :, 1]))
+        union = gw[..., None] * gh[..., None] + an_all[None, None, :, 0] * \
+            an_all[None, None, :, 1] - inter
+        best_anchor = jnp.argmax(inter / jnp.maximum(union, 1e-9), axis=-1)  # [N,B]
+        on_level = jnp.zeros_like(best_anchor, bool)
+        for li, a in enumerate(mask):
+            on_level = on_level | (best_anchor == a)
+        level_idx = jnp.zeros_like(best_anchor)
+        for li, a in enumerate(mask):
+            level_idx = jnp.where(best_anchor == a, li, level_idx)
+        assign = valid & on_level                              # [N, B]
+
+        gi = jnp.clip((gb[:, :, 0] * w).astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip((gb[:, :, 1] * h).astype(jnp.int32), 0, h - 1)
+
+        # targets in head space
+        txt = gb[:, :, 0] * w - gi
+        tyt = gb[:, :, 1] * h - gj
+        twt = jnp.log(jnp.maximum(gw / jnp.maximum(an[level_idx][..., 0], 1e-9),
+                                  1e-9))
+        tht = jnp.log(jnp.maximum(gh / jnp.maximum(an[level_idx][..., 1], 1e-9),
+                                  1e-9))
+        box_scale = 2.0 - gb[:, :, 2] * gb[:, :, 3]            # small-box upweight
+        score = gs if gs is not None else jnp.ones_like(txt)
+
+        # scatter gt info onto the [N, nm, h, w] grid
+        def scatter(vals):
+            out = jnp.zeros((n, nm, h, w), vals.dtype)
+            bidx = jnp.arange(n)[:, None] * jnp.ones((1, nb), jnp.int32)
+            flat = ((bidx * nm + level_idx) * h + gj) * w + gi
+            upd = jnp.where(assign, vals, 0.0)
+            out = out.reshape(-1).at[flat.reshape(-1)].add(
+                upd.reshape(-1), mode="drop")
+            return out.reshape(n, nm, h, w)
+
+        obj_mask = scatter(jnp.ones_like(txt)) > 0
+        sc = scatter(score * box_scale)
+        bce = lambda logit, t: jnp.maximum(logit, 0) - logit * t + \
+            jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+        loss_xy = (bce(tx, scatter(txt)) + bce(ty, scatter(tyt))) * sc
+        loss_wh = ((tw - scatter(twt)) ** 2 + (th - scatter(tht)) ** 2) * 0.5 * sc
+
+        # ignore mask: prediction boxes with IoU > thresh vs any gt
+        gxg = jnp.arange(w, dtype=jnp.float32)
+        gyg = jnp.arange(h, dtype=jnp.float32)
+        px = (jax.nn.sigmoid(tx) + gxg[None, None, None, :]) / w
+        py = (jax.nn.sigmoid(ty) + gyg[None, None, :, None]) / h
+        pw = jnp.exp(tw) * an[None, :, 0, None, None] / in_w
+        ph = jnp.exp(th) * an[None, :, 1, None, None] / in_h
+        p1x, p1y = px - pw / 2, py - ph / 2
+        p2x, p2y = px + pw / 2, py + ph / 2
+        g1x = gb[:, :, 0] - gb[:, :, 2] / 2
+        g1y = gb[:, :, 1] - gb[:, :, 3] / 2
+        g2x = gb[:, :, 0] + gb[:, :, 2] / 2
+        g2y = gb[:, :, 1] + gb[:, :, 3] / 2
+
+        def iou_vs_gts(p1x_, p1y_, p2x_, p2y_):
+            ix = jnp.maximum(
+                jnp.minimum(p2x_[..., None], g2x[:, None, None, None, :])
+                - jnp.maximum(p1x_[..., None], g1x[:, None, None, None, :]), 0)
+            iy = jnp.maximum(
+                jnp.minimum(p2y_[..., None], g2y[:, None, None, None, :])
+                - jnp.maximum(p1y_[..., None], g1y[:, None, None, None, :]), 0)
+            inter_ = ix * iy
+            pa = (p2x_ - p1x_) * (p2y_ - p1y_)
+            ga = ((g2x - g1x) * (g2y - g1y))[:, None, None, None, :]
+            iou = inter_ / jnp.maximum(pa[..., None] + ga - inter_, 1e-9)
+            return jnp.max(jnp.where(valid[:, None, None, None, :], iou, 0.0),
+                           axis=-1)
+
+        best_iou = iou_vs_gts(p1x, p1y, p2x, p2y)
+        noobj = (~obj_mask) & (best_iou < ignore_thresh)
+        loss_obj = bce(tobj, obj_mask.astype(tobj.dtype)) * jnp.where(
+            obj_mask, sc, noobj.astype(tobj.dtype))
+
+        smooth = 1.0 / class_num if use_label_smooth and class_num > 1 else 0.0
+        onehot = jax.nn.one_hot(jnp.where(assign, gl, 0), class_num)
+        onehot = onehot * (1 - smooth) + smooth / class_num
+        cls_t = scatter_cls(onehot, assign, level_idx, gj, gi, n, nm, h, w,
+                            class_num, nb)
+        loss_cls = (bce(tcls, cls_t)
+                    * obj_mask[:, :, None].astype(tcls.dtype)).sum(2)
+
+        total = (loss_xy + loss_wh + loss_obj + loss_cls)
+        return total.reshape(n, -1).sum(-1)
+
+    def scatter_cls(onehot, assign, level_idx, gj, gi, n, nm, h, w, ncls, nb):
+        out = jnp.zeros((n, nm, h, w, ncls), onehot.dtype)
+        bidx = jnp.arange(n)[:, None] * jnp.ones((1, nb), jnp.int32)
+        flat = ((bidx * nm + level_idx) * h + gj) * w + gi
+        upd = jnp.where(assign[..., None], onehot, 0.0)
+        out = out.reshape(-1, ncls).at[flat.reshape(-1)].add(
+            upd.reshape(-1, ncls), mode="drop")
+        return out.reshape(n, nm, h, w, ncls).transpose(0, 1, 4, 2, 3)
+
+    args = [x, gt_box, gt_label]
+    args.append(gt_score if gt_score is not None else None)
+    return apply_op(f, "yolo_loss", *args)
 
 
 def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0, dilation=1,
@@ -348,4 +532,77 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
                        pre_nms_top_n=6000, post_nms_top_n=1000, nms_thresh=0.5,
                        min_size=0.1, eta=1.0, pixel_offset=False, return_rois_num=False,
                        name=None):
-    raise NotImplementedError("generate_proposals lands with the detection pass")
+    """RPN proposal generation (reference ops.py generate_proposals / RPNHead).
+
+    scores: [N, A, H, W]; bbox_deltas: [N, 4*A, H, W]; anchors: [H*W*A, 4]
+    (x1,y1,x2,y2); variances: [H*W*A, 4]. Decode deltas onto anchors, clip to
+    the image, drop boxes under min_size, take pre_nms_top_n by score, NMS,
+    keep post_nms_top_n. Device decodes/filters (static shapes); the final
+    greedy NMS is host-side like `nms` above (data-dependent output size).
+    """
+    import jax.numpy as _jnp
+
+    def decode(sc, bd, imsz, anc, var):
+        n, a, h, w = sc.shape
+        sc_flat = sc.transpose(0, 2, 3, 1).reshape(n, -1)          # [N, HWA]
+        bd_flat = bd.reshape(n, a, 4, h, w).transpose(0, 3, 4, 1, 2).reshape(n, -1, 4)
+        anc = anc.reshape(-1, 4)
+        var = var.reshape(-1, 4)
+        aw = anc[:, 2] - anc[:, 0] + (1.0 if pixel_offset else 0.0)
+        ah = anc[:, 3] - anc[:, 1] + (1.0 if pixel_offset else 0.0)
+        acx = anc[:, 0] + aw * 0.5
+        acy = anc[:, 1] + ah * 0.5
+        dx = bd_flat[..., 0] * var[None, :, 0]
+        dy = bd_flat[..., 1] * var[None, :, 1]
+        dw = _jnp.clip(bd_flat[..., 2] * var[None, :, 2], -10.0, 4.135)
+        dh = _jnp.clip(bd_flat[..., 3] * var[None, :, 3], -10.0, 4.135)
+        cx = dx * aw[None] + acx[None]
+        cy = dy * ah[None] + acy[None]
+        bw = _jnp.exp(dw) * aw[None]
+        bh = _jnp.exp(dh) * ah[None]
+        off = 1.0 if pixel_offset else 0.0
+        x1 = cx - bw * 0.5
+        y1 = cy - bh * 0.5
+        x2 = cx + bw * 0.5 - off
+        y2 = cy + bh * 0.5 - off
+        imh = imsz[:, 0].astype(_jnp.float32)[:, None]
+        imw = imsz[:, 1].astype(_jnp.float32)[:, None]
+        x1 = _jnp.clip(x1, 0.0, None)
+        y1 = _jnp.clip(y1, 0.0, None)
+        x2 = _jnp.minimum(x2, imw - off)
+        y2 = _jnp.minimum(y2, imh - off)
+        keepable = ((x2 - x1 + off) >= min_size) & ((y2 - y1 + off) >= min_size)
+        sc_flat = _jnp.where(keepable, sc_flat, -_jnp.inf)
+        k = min(pre_nms_top_n, sc_flat.shape[1])
+        top_s, top_i = jax.lax.top_k(sc_flat, k)
+        boxes = _jnp.stack([x1, y1, x2, y2], -1)
+        top_b = _jnp.take_along_axis(boxes, top_i[..., None], axis=1)
+        return top_b, top_s
+
+    top_b, top_s = apply_op(decode, "generate_proposals_decode",
+                            scores, bbox_deltas, img_size,
+                            Tensor(jnp.asarray(np.asarray(
+                                anchors._value if isinstance(anchors, Tensor)
+                                else anchors))),
+                            Tensor(jnp.asarray(np.asarray(
+                                variances._value if isinstance(variances, Tensor)
+                                else variances))), nout=2)
+
+    # host-side NMS per image (greedy, data-dependent)
+    all_rois, rois_num = [], []
+    b_np = np.asarray(top_b._value)
+    s_np = np.asarray(top_s._value)
+    for i in range(b_np.shape[0]):
+        ok = np.isfinite(s_np[i])
+        bi, si = b_np[i][ok], s_np[i][ok]
+        keep = np.asarray(nms(Tensor(jnp.asarray(bi)), nms_thresh,
+                              scores=Tensor(jnp.asarray(si)))._value)
+        keep = keep[:post_nms_top_n]
+        all_rois.append(bi[keep])
+        rois_num.append(len(keep))
+    rois = Tensor(jnp.asarray(np.concatenate(all_rois, 0) if all_rois
+                              else np.zeros((0, 4), np.float32)))
+    nums = Tensor(jnp.asarray(np.asarray(rois_num, np.int32)))
+    if return_rois_num:
+        return rois, Tensor(jnp.asarray(s_np)), nums
+    return rois, Tensor(jnp.asarray(s_np))

@@ -1,0 +1,111 @@
+"""Detection ops that were NotImplementedError in round 2: psroi_pool,
+yolo_loss, generate_proposals."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+def test_psroi_pool_pools_position_sensitive_groups():
+    oh = ow = 2
+    out_c = 3
+    C = out_c * oh * ow
+    # constant-per-channel feature map: output bin (i,j) of group c must equal
+    # the constant of channel c*oh*ow + i*ow + j
+    feat = np.zeros((1, C, 8, 8), "float32")
+    for c in range(C):
+        feat[0, c] = c
+    boxes = paddle.to_tensor(np.array([[0.0, 0.0, 8.0, 8.0]], "float32"))
+    boxes_num = paddle.to_tensor(np.array([1], "int32"))
+    out = V.psroi_pool(paddle.to_tensor(feat), boxes, boxes_num, (oh, ow))
+    got = np.asarray(out._value)  # [1, out_c, oh, ow]
+    assert got.shape == (1, out_c, oh, ow)
+    for c in range(out_c):
+        for i in range(oh):
+            for j in range(ow):
+                assert got[0, c, i, j] == pytest.approx(c * oh * ow + i * ow + j), (
+                    c, i, j, got[0, c])
+
+
+def test_psroi_pool_class_wrapper():
+    layer = V.PSRoIPool(2, spatial_scale=1.0)
+    feat = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+        (1, 8, 6, 6)).astype("float32"))
+    boxes = paddle.to_tensor(np.array([[1.0, 1.0, 5.0, 5.0]], "float32"))
+    out = layer(feat, boxes, paddle.to_tensor(np.array([1], "int32")))
+    assert tuple(out.shape) == (1, 2, 2, 2)
+
+
+def _yolo_inputs(rng, n=2, h=4, w=4, class_num=3, nm=3):
+    c = nm * (5 + class_num)
+    x = rng.standard_normal((n, c, h, w)).astype("float32")
+    gt_box = np.zeros((n, 5, 4), "float32")
+    gt_box[:, 0] = [0.5, 0.5, 0.4, 0.3]   # one real box per image
+    gt_label = np.zeros((n, 5), "int64")
+    return x, gt_box, gt_label
+
+
+ANCHORS = [10, 13, 16, 30, 33, 23, 30, 61, 62, 45, 59, 119, 116, 90, 156, 198,
+           373, 326]
+
+
+def test_yolo_loss_basic_properties():
+    rng = np.random.default_rng(0)
+    x, gt_box, gt_label = _yolo_inputs(rng)
+    loss = V.yolo_loss(paddle.to_tensor(x), paddle.to_tensor(gt_box),
+                       paddle.to_tensor(gt_label), anchors=ANCHORS,
+                       anchor_mask=[6, 7, 8], class_num=3, ignore_thresh=0.7,
+                       downsample_ratio=32)
+    got = np.asarray(loss._value)
+    assert got.shape == (2,)
+    assert np.all(np.isfinite(got)) and np.all(got > 0)
+
+
+def test_yolo_loss_gradient_flows_and_decreases():
+    rng = np.random.default_rng(1)
+    x, gt_box, gt_label = _yolo_inputs(rng)
+    t = paddle.to_tensor(x, stop_gradient=False)
+    args = dict(anchors=ANCHORS, anchor_mask=[6, 7, 8], class_num=3,
+                ignore_thresh=0.7, downsample_ratio=32)
+    loss = V.yolo_loss(t, paddle.to_tensor(gt_box), paddle.to_tensor(gt_label),
+                       **args).sum()
+    loss.backward()
+    g = np.asarray(t.grad)
+    assert np.any(g != 0)
+    # one gradient step reduces the loss (sanity that it is minimizable)
+    x2 = x - 0.1 * g
+    loss2 = V.yolo_loss(paddle.to_tensor(x2), paddle.to_tensor(gt_box),
+                        paddle.to_tensor(gt_label), **args).sum()
+    assert float(loss2.numpy()) < float(loss.numpy())
+
+
+def test_generate_proposals_shapes_and_ordering():
+    rng = np.random.default_rng(2)
+    N, A, H, W = 1, 3, 4, 4
+    scores = rng.uniform(0, 1, (N, A, H, W)).astype("float32")
+    deltas = (rng.standard_normal((N, 4 * A, H, W)) * 0.1).astype("float32")
+    # anchors: grid of 16x16 boxes
+    anc = []
+    for i in range(H):
+        for j in range(W):
+            for a in range(A):
+                cx, cy = j * 16 + 8, i * 16 + 8
+                s = 8 * (a + 1)
+                anc.append([cx - s, cy - s, cx + s, cy + s])
+    anchors = np.asarray(anc, "float32")
+    variances = np.ones_like(anchors)
+    img_size = np.array([[64, 64]], "float32")
+
+    rois, s, nums = V.generate_proposals(
+        paddle.to_tensor(scores), paddle.to_tensor(deltas),
+        paddle.to_tensor(img_size), paddle.to_tensor(anchors),
+        paddle.to_tensor(variances), pre_nms_top_n=30, post_nms_top_n=10,
+        nms_thresh=0.7, min_size=1.0, return_rois_num=True)
+    r = np.asarray(rois._value)
+    n_kept = int(np.asarray(nums._value)[0])
+    assert r.shape == (n_kept, 4) and 1 <= n_kept <= 10
+    # all inside the image
+    assert np.all(r[:, 0] >= 0) and np.all(r[:, 2] <= 64)
+    assert np.all(r[:, 1] >= 0) and np.all(r[:, 3] <= 64)
+    assert np.all(r[:, 2] > r[:, 0]) and np.all(r[:, 3] > r[:, 1])
