@@ -1,0 +1,263 @@
+"""paddle.quantization — QAT fake-quant + PTQ observers.
+
+Reference: python/paddle/quantization/ (QuantConfig config.py:67, QAT qat.py,
+PTQ ptq.py, quanters/FakeQuanterWithAbsMaxObserver, observers/AbsmaxObserver).
+
+TPU-native: fake-quant is a pure function (round with straight-through
+gradients via a custom vjp-free formulation: q = x + stop_gradient(quant(x) -
+x)), so QAT graphs stay fully traceable/compilable; observers are host-updated
+running statistics consulted at convert time.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.layer import Layer
+from ..ops import apply_op
+from ..tensor import Tensor
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "quanters", "observers",
+           "FakeQuanterWithAbsMaxObserver", "AbsmaxObserver", "QuantedLinear"]
+
+
+def fake_quant(x, scale, bit_length=8):
+    """Symmetric per-tensor fake quantization with straight-through estimator:
+    forward sees the quantized value, backward sees identity."""
+    import jax
+
+    def f(v, s):
+        bnd = float(2 ** (bit_length - 1) - 1)
+        s = jnp.maximum(s, 1e-9)
+        q = jnp.clip(jnp.round(v / s * bnd), -bnd, bnd) * s / bnd
+        return v + jax.lax.stop_gradient(q - v)
+
+    return apply_op(f, "fake_quant", x, scale)
+
+
+# ------------------------------------------------------------------ observers
+class AbsmaxObserver:
+    """Running abs-max observer (reference observers/abs_max.py)."""
+
+    def __init__(self, quant_bits=8):
+        self.quant_bits = quant_bits
+        self._max = 0.0
+
+    def observe(self, x):
+        v = x._value if isinstance(x, Tensor) else x
+        self._max = max(self._max, float(jnp.max(jnp.abs(v))))
+
+    def scale(self):
+        return self._max if self._max > 0 else 1e-9
+
+
+class EMAObserver:
+    """Exponential-moving-average abs-max (QAT activation statistic,
+    reference quanters/FakeQuanterWithAbsMaxObserver moving_rate)."""
+
+    def __init__(self, quant_bits=8, moving_rate=0.9):
+        self.quant_bits = quant_bits
+        self.moving_rate = moving_rate
+        self._state = None
+
+    def observe(self, x):
+        v = x._value if isinstance(x, Tensor) else x
+        cur = float(jnp.max(jnp.abs(v)))
+        if self._state is None:
+            self._state = cur
+        else:
+            r = self.moving_rate
+            self._state = r * self._state + (1 - r) * cur
+
+    def scale(self):
+        return self._state if self._state else 1e-9
+
+
+class observers:  # namespace parity
+    AbsmaxObserver = AbsmaxObserver
+    EMAObserver = EMAObserver
+
+
+# ------------------------------------------------------------------ quanters
+class FakeQuanterWithAbsMaxObserver(Layer):
+    """Fake-quant layer updating an EMA abs-max scale in training
+    (reference quanters/abs_max.py)."""
+
+    def __init__(self, moving_rate=0.9, bit_length=8, dtype="float32"):
+        super().__init__()
+        self.bit_length = bit_length
+        self._observer = EMAObserver(bit_length, moving_rate)
+
+    def forward(self, x):
+        if self.training:
+            self._observer.observe(x)
+        scale = Tensor(jnp.asarray(np.float32(self._observer.scale())))
+        return fake_quant(x, scale, self.bit_length)
+
+    def quant_scale(self):
+        return self._observer.scale()
+
+
+class quanters:  # namespace parity
+    FakeQuanterWithAbsMaxObserver = FakeQuanterWithAbsMaxObserver
+
+
+# ------------------------------------------------------------------ config
+class QuantConfig:
+    """Reference config.py:67 — maps layers/types to quanter factories."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+        self._layer_cfg = {}
+        self._type_cfg = {}
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        for l in layer if isinstance(layer, (list, tuple)) else [layer]:
+            self._layer_cfg[id(l)] = (activation, weight)
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        for t in (layer_type if isinstance(layer_type, (list, tuple))
+                  else [layer_type]):
+            self._type_cfg[t] = (activation, weight)
+
+    def config_for(self, layer):
+        if id(layer) in self._layer_cfg:
+            return self._layer_cfg[id(layer)]
+        for t, cfg in self._type_cfg.items():
+            if isinstance(layer, t):
+                return cfg
+        return (self.activation, self.weight)
+
+
+def _make(factory):
+    if factory is None:
+        return None
+    return factory() if callable(factory) else factory
+
+
+class QuantedLinear(Layer):
+    """Linear with fake-quanted activation+weight (QAT wrapper,
+    reference nn/quant/qat/linear.py role)."""
+
+    def __init__(self, linear, activation_quanter, weight_quanter):
+        super().__init__()
+        self.inner = linear
+        self.activation_quanter = activation_quanter
+        self.weight_quanter = weight_quanter
+
+    def forward(self, x):
+        from ..nn import functional as F
+
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.inner.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        return F.linear(x, w, self.inner.bias)
+
+    @property
+    def weight(self):
+        return self.inner.weight
+
+    @property
+    def bias(self):
+        return self.inner.bias
+
+
+class QAT:
+    """Reference qat.py — wrap quantizable sublayers with fake-quant."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model, inplace=False):
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+        self._swap(model)
+        return model
+
+    def _swap(self, layer):
+        from ..nn.layer_common import Linear
+
+        for name, sub in list(layer._sub_layers.items()):
+            if isinstance(sub, Linear):
+                act, w = self.config.config_for(sub)
+                if act is None and w is None:
+                    continue
+                layer._sub_layers[name] = QuantedLinear(
+                    sub, _make(act), _make(w))
+            else:
+                self._swap(sub)
+
+
+class _FixedScaleQuanter(Layer):
+    """Fake-quant with a frozen (calibrated) scale — PTQ convert output."""
+
+    def __init__(self, scale, bit_length=8):
+        super().__init__()
+        self._scale = float(scale)
+        self.bit_length = bit_length
+
+    def forward(self, x):
+        return fake_quant(x, Tensor(jnp.asarray(np.float32(self._scale))),
+                          self.bit_length)
+
+    def quant_scale(self):
+        return self._scale
+
+
+class PTQ:
+    """Reference ptq.py — quantize() installs calibration hooks; the caller
+    runs sample batches; convert() freezes the CALIBRATED activation scales
+    into fixed fake-quanters, statically quantizes weights, and removes the
+    calibration hooks."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+        self._observers = {}
+        self._hooks = []
+
+    def quantize(self, model, inplace=False):
+        from ..nn.layer_common import Linear
+
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+        for name, sub in model.named_sublayers():
+            if isinstance(sub, Linear):
+                obs = AbsmaxObserver()
+                self._observers[name] = (sub, obs)
+                handle = sub.register_forward_post_hook(
+                    lambda layer, inp, out, _o=obs: (_o.observe(inp[0]), None)[1])
+                self._hooks.append(handle)
+        return model
+
+    def convert(self, model, inplace=False):
+        for name, (sub, obs) in self._observers.items():
+            # weights: static symmetric quantization
+            w = sub.weight
+            wobs = AbsmaxObserver()
+            wobs.observe(w)
+            scale = Tensor(jnp.asarray(np.float32(wobs.scale())))
+            sub.weight._value = fake_quant(w, scale)._value
+            # activations: frozen calibrated scale applied at runtime
+            self._swap_in_model(model, sub, _FixedScaleQuanter(obs.scale()))
+        for h in self._hooks:
+            try:
+                h.remove()
+            except AttributeError:
+                pass
+        self._hooks = []
+        return model
+
+    @staticmethod
+    def _swap_in_model(model, linear, act_quanter):
+        for parent in model.sublayers(include_self=True):
+            for name, sub in list(parent._sub_layers.items()):
+                if sub is linear:
+                    parent._sub_layers[name] = QuantedLinear(
+                        linear, act_quanter, None)

@@ -61,26 +61,28 @@ class Imdb(Dataset):
             if download:
                 raise RuntimeError(_NO_EGRESS)
             raise ValueError(f"Imdb needs data_file ({_NO_EGRESS})")
-        pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        # vocab is built over BOTH splits (reference imdb.py matches
+        # aclImdb/((train)|(test))/...) so train/test indices are compatible
+        vocab_pat = re.compile(r"aclImdb/(train|test)/(pos|neg)/.*\.txt$")
+        mode_pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
         tokenizer = re.compile(r"\w+")
         docs, labels = [], []
         freq: dict[str, int] = {}
         with tarfile.open(data_file, "r:*") as tf:
             for member in tf.getmembers():
-                m = pat.match(member.name)
-                if not m:
+                if not vocab_pat.match(member.name):
                     continue
                 text = tf.extractfile(member).read().decode("utf-8", "ignore")
                 words = [w.lower() for w in tokenizer.findall(text)]
-                docs.append(words)
-                labels.append(0 if m.group(1) == "pos" else 1)
                 for w in words:
                     freq[w] = freq.get(w, 0) + 1
-        # build word dict by frequency with cutoff (reference builds on train)
+                m = mode_pat.match(member.name)
+                if m:
+                    docs.append(words)
+                    labels.append(0 if m.group(1) == "pos" else 1)
+        # reference semantics: keep words with freq STRICTLY above cutoff
         vocab = [w for w, c in sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
-                 if c >= min(cutoff, max(freq.values(), default=0))]
-        if not vocab:
-            vocab = sorted(freq)
+                 if c > cutoff]
         self.word_idx = {w: i for i, w in enumerate(vocab)}
         self.word_idx["<unk>"] = len(self.word_idx)
         unk = self.word_idx["<unk>"]
@@ -106,12 +108,15 @@ class Imikolov(Dataset):
                 raise RuntimeError(_NO_EGRESS)
             raise ValueError(f"Imikolov needs data_file ({_NO_EGRESS})")
         split = "train" if mode == "train" else "valid"
-        lines = []
+        lines = None
         with tarfile.open(data_file, "r:*") as tf:
             for member in tf.getmembers():
                 if member.name.endswith(f"ptb.{split}.txt"):
                     data = tf.extractfile(member).read().decode()
                     lines = [l.strip().split() for l in data.splitlines() if l.strip()]
+        if lines is None:
+            raise ValueError(
+                f"{data_file!r} has no ptb.{split}.txt member — wrong archive?")
         freq: dict[str, int] = {}
         for words in lines:
             for w in words:

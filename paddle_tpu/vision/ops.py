@@ -168,17 +168,22 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
         yi = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, H - 1)
         xi = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, W - 1)
 
-        # bin (i,j) pools its own sample rows/cols from its own channel group;
-        # selecting the diagonal over (bin, sample-bin) axes is a one-hot
-        # contraction — XLA fuses it into a gather
-        def per_roi(bi, yy, xx):
-            fmap = feat[bi].reshape(out_c, oh, ow, H, W)
-            sampled = fmap[:, :, :, yy, :][:, :, :, :, xx]
-            s = sampled.reshape(out_c, oh, ow, oh, sr, ow, sr)
-            s = s.mean(axis=(4, 6))                           # [out_c,oh,ow,oh,ow]
-            eye_h = jnp.eye(oh)
-            eye_w = jnp.eye(ow)
-            return jnp.einsum("cijkl,ik,jl->cij", s, eye_h, eye_w)
+        # each output bin (i, j) samples ONLY its own channel group and its own
+        # sr×sr sample sub-grid — a double vmap over bins, no (bin, sample-bin)
+        # cross product is materialized
+        def per_roi(bi, yrow, xrow):
+            g = feat[bi].reshape(out_c, oh, ow, H, W)
+            yb = yrow.reshape(oh, sr)
+            xb = xrow.reshape(ow, sr)
+
+            def per_bin(i, j):
+                patch = g[:, i, j]               # [out_c, H, W]
+                vals = patch[:, yb[i], :][:, :, xb[j]]  # [out_c, sr, sr]
+                return vals.mean(axis=(1, 2))
+
+            grid = jax.vmap(lambda i: jax.vmap(lambda j: per_bin(i, j))(
+                jnp.arange(ow)))(jnp.arange(oh))  # [oh, ow, out_c]
+            return jnp.transpose(grid, (2, 0, 1))
 
         return jax.vmap(per_roi)(batch_idx, yi, xi)
 
@@ -331,18 +336,28 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
         box_scale = 2.0 - gb[:, :, 2] * gb[:, :, 3]            # small-box upweight
         score = gs if gs is not None else jnp.ones_like(txt)
 
-        # scatter gt info onto the [N, nm, h, w] grid
+        # scatter gt info onto the [N, nm, h, w] grid. Collisions (two gts in
+        # the same cell/level) OVERWRITE — last writer wins like the reference's
+        # per-gt loop — never sum, which would fabricate out-of-range targets.
+        bidx_all = jnp.arange(n)[:, None] * jnp.ones((1, nb), jnp.int32)
+        flat_all = ((bidx_all * nm + level_idx) * h + gj) * w + gi
+        sink = n * nm * h * w  # unassigned gts scatter off the end (dropped)
+        flat_assigned = jnp.where(assign, flat_all, sink)
+
         def scatter(vals):
-            out = jnp.zeros((n, nm, h, w), vals.dtype)
-            bidx = jnp.arange(n)[:, None] * jnp.ones((1, nb), jnp.int32)
-            flat = ((bidx * nm + level_idx) * h + gj) * w + gi
-            upd = jnp.where(assign, vals, 0.0)
-            out = out.reshape(-1).at[flat.reshape(-1)].add(
-                upd.reshape(-1), mode="drop")
+            out = jnp.zeros((n * nm * h * w,), vals.dtype)
+            out = out.at[flat_assigned.reshape(-1)].set(
+                vals.reshape(-1), mode="drop")
             return out.reshape(n, nm, h, w)
 
         obj_mask = scatter(jnp.ones_like(txt)) > 0
         sc = scatter(score * box_scale)
+        # scale_x_y (PP-YOLO grid-sensitive decode): the head emits
+        # sigmoid(t)*s - (s-1)/2, so the BCE target for sigmoid(t) is
+        # (frac + (s-1)/2) / s
+        sxy = float(scale_x_y)
+        txt = (txt + (sxy - 1) / 2) / sxy
+        tyt = (tyt + (sxy - 1) / 2) / sxy
         bce = lambda logit, t: jnp.maximum(logit, 0) - logit * t + \
             jnp.log1p(jnp.exp(-jnp.abs(logit)))
 
@@ -352,8 +367,10 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
         # ignore mask: prediction boxes with IoU > thresh vs any gt
         gxg = jnp.arange(w, dtype=jnp.float32)
         gyg = jnp.arange(h, dtype=jnp.float32)
-        px = (jax.nn.sigmoid(tx) + gxg[None, None, None, :]) / w
-        py = (jax.nn.sigmoid(ty) + gyg[None, None, :, None]) / h
+        px = (jax.nn.sigmoid(tx) * sxy - (sxy - 1) / 2
+              + gxg[None, None, None, :]) / w
+        py = (jax.nn.sigmoid(ty) * sxy - (sxy - 1) / 2
+              + gyg[None, None, :, None]) / h
         pw = jnp.exp(tw) * an[None, :, 0, None, None] / in_w
         ph = jnp.exp(th) * an[None, :, 1, None, None] / in_h
         p1x, p1y = px - pw / 2, py - ph / 2
@@ -385,21 +402,18 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
         smooth = 1.0 / class_num if use_label_smooth and class_num > 1 else 0.0
         onehot = jax.nn.one_hot(jnp.where(assign, gl, 0), class_num)
         onehot = onehot * (1 - smooth) + smooth / class_num
-        cls_t = scatter_cls(onehot, assign, level_idx, gj, gi, n, nm, h, w,
-                            class_num, nb)
+        cls_t = scatter_cls(onehot, flat_assigned, n, nm, h, w, class_num)
         loss_cls = (bce(tcls, cls_t)
                     * obj_mask[:, :, None].astype(tcls.dtype)).sum(2)
 
         total = (loss_xy + loss_wh + loss_obj + loss_cls)
         return total.reshape(n, -1).sum(-1)
 
-    def scatter_cls(onehot, assign, level_idx, gj, gi, n, nm, h, w, ncls, nb):
-        out = jnp.zeros((n, nm, h, w, ncls), onehot.dtype)
-        bidx = jnp.arange(n)[:, None] * jnp.ones((1, nb), jnp.int32)
-        flat = ((bidx * nm + level_idx) * h + gj) * w + gi
-        upd = jnp.where(assign[..., None], onehot, 0.0)
-        out = out.reshape(-1, ncls).at[flat.reshape(-1)].add(
-            upd.reshape(-1, ncls), mode="drop")
+    def scatter_cls(onehot, flat_assigned, n, nm, h, w, ncls):
+        # overwrite, not add: collisions keep ONE box's class row (see scatter)
+        out = jnp.zeros((n * nm * h * w, ncls), onehot.dtype)
+        out = out.at[flat_assigned.reshape(-1)].set(
+            onehot.reshape(-1, ncls), mode="drop")
         return out.reshape(n, nm, h, w, ncls).transpose(0, 1, 4, 2, 3)
 
     args = [x, gt_box, gt_label]
@@ -589,7 +603,7 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
                                 else variances))), nout=2)
 
     # host-side NMS per image (greedy, data-dependent)
-    all_rois, rois_num = [], []
+    all_rois, all_scores, rois_num = [], [], []
     b_np = np.asarray(top_b._value)
     s_np = np.asarray(top_s._value)
     for i in range(b_np.shape[0]):
@@ -599,10 +613,15 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
                               scores=Tensor(jnp.asarray(si)))._value)
         keep = keep[:post_nms_top_n]
         all_rois.append(bi[keep])
+        all_scores.append(si[keep])
         rois_num.append(len(keep))
     rois = Tensor(jnp.asarray(np.concatenate(all_rois, 0) if all_rois
                               else np.zeros((0, 4), np.float32)))
+    # scores aligned 1:1 with rois (reference rpn_roi_probs contract)
+    scores_out = Tensor(jnp.asarray(
+        np.concatenate(all_scores, 0) if all_scores
+        else np.zeros((0,), np.float32)))
     nums = Tensor(jnp.asarray(np.asarray(rois_num, np.int32)))
     if return_rois_num:
-        return rois, Tensor(jnp.asarray(s_np)), nums
-    return rois, Tensor(jnp.asarray(s_np))
+        return rois, scores_out, nums
+    return rois, scores_out

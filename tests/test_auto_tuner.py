@@ -1,0 +1,104 @@
+"""Auto-tuner tests (VERDICT missing #10): candidates, pruning, search, live trials."""
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.auto_tuner import (
+    AutoTuner, ModelSpec, estimate_memory_bytes, estimate_step_time,
+    generate_candidates,
+)
+
+
+def test_candidates_cover_factorizations():
+    cands = generate_candidates(8, use_sharding=False)
+    combos = {(c["dp_degree"], c["mp_degree"], c["pp_degree"]) for c in cands}
+    for dp, mp, pp in combos:
+        assert dp * mp * pp == 8
+    assert (8, 1, 1) in combos and (1, 8, 1) in combos and (2, 2, 2) in combos
+
+
+def test_memory_model_monotone_in_sharding():
+    spec = ModelSpec(num_params=1.3e9, num_layers=24, hidden=2048, seq_len=1024,
+                     global_batch=32)
+    base = {"dp_degree": 8, "mp_degree": 1, "pp_degree": 1, "micro_batches": 1}
+    mems = [estimate_memory_bytes({**base, "sharding_stage": s}, spec)
+            for s in (0, 1, 2, 3)]
+    assert mems[0] > mems[1] > mems[2] > mems[3]
+
+
+def test_cost_model_prefers_dp_for_small_models():
+    spec = ModelSpec(num_params=3.5e8, num_layers=24, hidden=1024, seq_len=1024,
+                     global_batch=64)
+    t_dp = estimate_step_time({"dp_degree": 8, "mp_degree": 1, "pp_degree": 1,
+                               "sharding_stage": 0, "micro_batches": 1}, spec)
+    t_mp = estimate_step_time({"dp_degree": 1, "mp_degree": 8, "pp_degree": 1,
+                               "sharding_stage": 0, "micro_batches": 1}, spec)
+    assert t_dp < t_mp  # mp pays per-layer activation all-reduces
+
+
+def test_tuner_prunes_oom_and_orders_by_estimate():
+    spec = ModelSpec(num_params=1.3e9, num_layers=24, hidden=2048, seq_len=1024,
+                     global_batch=16)  # 1.3B: unsharded replication needs ~23GB
+    tuner = AutoTuner({"world_size": 8, "model_spec": spec, "hbm_bytes": 16e9})
+    combos = {(c["dp_degree"], c["mp_degree"], c["pp_degree"],
+               c["sharding_stage"]) for c in tuner.candidates}
+    assert (8, 1, 1, 0) not in combos, "unsharded dp-only 1.3B must be pruned"
+    assert any(s >= 1 or mp > 1 or pp > 1 for _, mp, pp, s in combos), \
+        "sharded / model-parallel configs must survive"
+
+
+def test_tune_runs_trials_and_picks_best():
+    spec = ModelSpec(num_params=3.5e8, num_layers=24, hidden=1024, seq_len=1024,
+                     global_batch=64)
+    tuner = AutoTuner({"world_size": 8, "model_spec": spec, "task_limit": 6})
+
+    seen = []
+
+    def trial(cfg):
+        seen.append(cfg)
+        if cfg["mp_degree"] >= 4:
+            raise RuntimeError("simulated bad config")
+        return 100.0 + cfg["dp_degree"]  # synthetic: prefer highest dp
+
+    best = tuner.tune(trial)
+    assert best is not None
+    assert len(seen) == 6
+    want = max(100.0 + c["dp_degree"] for c in seen if c["mp_degree"] < 4)
+    assert best["metric"] == want
+    failures = [h for h in tuner.history if h["error"] is not None]
+    assert all("simulated" in f["error"] for f in failures)
+
+
+def test_tuner_with_real_dryrun_trials():
+    """Live trials: each candidate jit-compiles a tiny sharded matmul step on
+    the 8-device CPU mesh and reports measured throughput."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    spec = ModelSpec(num_params=1e6, num_layers=2, hidden=64, seq_len=32,
+                     global_batch=16)
+    tuner = AutoTuner({"world_size": 8, "model_spec": spec, "task_limit": 3,
+                       "use_sharding": False, "max_pp": 1})
+    devices = np.array(jax.devices()[:8])
+
+    def trial(cfg):
+        dp, mp, pp = cfg["dp_degree"], cfg["mp_degree"], cfg["pp_degree"]
+        if pp > 1:
+            raise RuntimeError("pp not exercised in this tiny trial")
+        mesh = Mesh(devices.reshape(dp, mp), ("dp", "mp"))
+        x = jax.device_put(np.random.randn(16, 64).astype("float32"),
+                           NamedSharding(mesh, P("dp", None)))
+        w = jax.device_put(np.random.randn(64, 64).astype("float32"),
+                           NamedSharding(mesh, P(None, "mp")))
+        f = jax.jit(lambda a, b: jnp.tanh(a @ b).sum())
+        float(f(x, w))
+        t0 = time.perf_counter()
+        for _ in range(3):
+            out = f(x, w)
+        float(out)
+        return 3 / (time.perf_counter() - t0)
+
+    best = tuner.tune(trial)
+    assert best is not None and best["metric"] > 0

@@ -1,0 +1,95 @@
+"""paddle.quantization tests: fake-quant STE, observers, QAT wrap, PTQ flow."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn
+from paddle_tpu.quantization import (
+    QAT, PTQ, AbsmaxObserver, FakeQuanterWithAbsMaxObserver, QuantConfig,
+    QuantedLinear, fake_quant,
+)
+
+
+def test_fake_quant_values_and_ste():
+    x = paddle.to_tensor(np.array([-1.0, -0.26, 0.0, 0.26, 1.0], "float32"),
+                         stop_gradient=False)
+    scale = paddle.to_tensor(np.float32(1.0))
+    q = fake_quant(x, scale, bit_length=8)
+    got = np.asarray(q._value)
+    bnd = 127.0
+    want = np.clip(np.round(np.array([-1.0, -0.26, 0.0, 0.26, 1.0]) * bnd),
+                   -bnd, bnd) / bnd
+    np.testing.assert_allclose(got, want, atol=1e-6)
+    # straight-through gradient: d(sum(q))/dx == 1 everywhere
+    q.sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad), np.ones(5), atol=1e-6)
+
+
+def test_absmax_observer():
+    obs = AbsmaxObserver()
+    obs.observe(paddle.to_tensor(np.array([0.5, -2.0], "float32")))
+    obs.observe(paddle.to_tensor(np.array([1.0], "float32")))
+    assert obs.scale() == pytest.approx(2.0)
+
+
+def test_fake_quanter_layer_updates_scale_in_training():
+    fq = FakeQuanterWithAbsMaxObserver(moving_rate=0.5)
+    fq.train()
+    x = paddle.to_tensor(np.array([4.0, -4.0], "float32"))
+    fq(x)
+    s1 = fq.quant_scale()
+    assert s1 == pytest.approx(4.0)
+    fq(paddle.to_tensor(np.array([8.0], "float32")))
+    assert fq.quant_scale() == pytest.approx(0.5 * 4.0 + 0.5 * 8.0)
+    fq.eval()
+    before = fq.quant_scale()
+    fq(paddle.to_tensor(np.array([100.0], "float32")))
+    assert fq.quant_scale() == before  # eval does not update stats
+
+
+def test_qat_wraps_linear_and_trains():
+    with paddle.utils.unique_name.guard():
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    cfg = QuantConfig(activation=FakeQuanterWithAbsMaxObserver,
+                      weight=FakeQuanterWithAbsMaxObserver)
+    q = QAT(cfg)
+    qm = q.quantize(m, inplace=True)
+    kinds = [type(l).__name__ for l in qm.sublayers()]
+    assert kinds.count("QuantedLinear") == 2
+    # still trains
+    opt = paddle.optimizer.SGD(0.1, parameters=qm.parameters())
+    x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+        (16, 8)).astype("float32"))
+    y = paddle.to_tensor(np.random.default_rng(1).integers(0, 4, (16,)))
+    qm.train()
+    losses = []
+    for _ in range(8):
+        loss = F.cross_entropy(qm(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_ptq_calibrate_and_convert():
+    with paddle.utils.unique_name.guard():
+        paddle.seed(1)
+        m = nn.Sequential(nn.Linear(8, 8))
+    ptq = PTQ(QuantConfig(activation=None, weight=None))
+    m = ptq.quantize(m)
+    x = paddle.to_tensor(np.random.default_rng(2).standard_normal(
+        (4, 8)).astype("float32"))
+    m.eval()
+    ref = m(x).numpy()
+    ptq.convert(m)
+    out = m(x).numpy()
+    # weights got snapped to the 8-bit grid: output close but not identical
+    assert not np.allclose(out, ref, atol=1e-7)
+    np.testing.assert_allclose(out, ref, rtol=0.2, atol=0.05)
+    w = np.asarray(m[0].weight._value)
+    scale = np.abs(w).max()
+    steps = w / (scale / 127.0)
+    np.testing.assert_allclose(steps, np.round(steps), atol=1e-3)
