@@ -10,7 +10,9 @@ from ..framework.device import (  # noqa: F401
 
 __all__ = ["set_device", "get_device", "get_all_device_type", "get_all_custom_device_type",
            "get_available_device", "get_available_custom_device", "device_count",
-           "synchronize", "cuda", "Stream", "Event", "stream_guard", "current_stream"]
+           "synchronize", "cuda", "Stream", "Event", "stream_guard", "current_stream",
+           "memory_stats", "memory_allocated", "max_memory_allocated",
+           "memory_reserved", "max_memory_reserved", "empty_cache"]
 
 
 def get_all_device_type():
@@ -37,6 +39,79 @@ def synchronize(device=None):
     jnp.zeros(()).block_until_ready()
 
 
+# ------------------------------------------------------------------ memory
+def _resolve_device(device=None):
+    if device is None:
+        return jax.devices()[0]
+    if isinstance(device, int):
+        return jax.devices()[device]
+    if hasattr(device, "memory_stats"):
+        return device
+    plat, _, idx = str(device).partition(":")
+    devs = jax.devices(plat) if plat else jax.devices()
+    return devs[int(idx) if idx else 0]
+
+
+def memory_stats(device=None):
+    """Raw PJRT allocator stats (reference: phi memory stats / paddle.device.cuda
+    memory API family). TPU returns bytes_in_use / peak_bytes_in_use /
+    bytes_limit etc.; backends without an instrumented allocator return {}."""
+    d = _resolve_device(device)
+    return d.memory_stats() or {}
+
+
+def _live_bytes(d):
+    # fallback accounting: sum of live jax arrays resident on this device
+    total = 0
+    for arr in jax.live_arrays():
+        try:
+            for sh in arr.addressable_shards:
+                if sh.device == d:
+                    total += sh.data.nbytes
+        except Exception:
+            continue
+    return total
+
+
+def memory_allocated(device=None):
+    """Bytes currently allocated on the device (live buffers)."""
+    d = _resolve_device(device)
+    stats = d.memory_stats() or {}
+    if "bytes_in_use" in stats:
+        return int(stats["bytes_in_use"])
+    return _live_bytes(d)
+
+
+def max_memory_allocated(device=None):
+    """Peak bytes allocated (PJRT peak counter; falls back to current)."""
+    d = _resolve_device(device)
+    stats = d.memory_stats() or {}
+    if "peak_bytes_in_use" in stats:
+        return int(stats["peak_bytes_in_use"])
+    return _live_bytes(d)
+
+
+def memory_reserved(device=None):
+    """Bytes the allocator holds from the system (pool size / HBM limit)."""
+    d = _resolve_device(device)
+    stats = d.memory_stats() or {}
+    for key in ("bytes_reserved", "pool_bytes", "bytes_limit"):
+        if key in stats:
+            return int(stats[key])
+    return memory_allocated(device)
+
+
+max_memory_reserved = memory_reserved
+
+
+def empty_cache():
+    """Release cached host-side references so XLA can reuse device memory
+    (XLA's allocator frees buffers when their arrays are garbage-collected)."""
+    import gc
+
+    gc.collect()
+
+
 class Stream:
     """XLA schedules its own streams; this exists for API parity and ordering is a no-op
     (all work on one device is program-ordered)."""
@@ -61,17 +136,30 @@ class Stream:
 
 
 class Event:
-    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
-        pass
+    """Timing events: record() syncs the device then timestamps, so
+    a.elapsed_time(b) measures real device wall-clock between the records
+    (XLA-async safe). query/synchronize are immediate post-sync."""
+
+    def __init__(self, enable_timing=True, blocking=False, interprocess=False):
+        self._ts = None
 
     def record(self, stream=None):
-        pass
+        synchronize()
+        import time
+
+        self._ts = time.perf_counter()
 
     def query(self):
         return True
 
     def synchronize(self):
         synchronize()
+
+    def elapsed_time(self, end_event):
+        """Milliseconds between this record() and `end_event`'s record()."""
+        if self._ts is None or end_event._ts is None:
+            raise RuntimeError("both events must be recorded before elapsed_time")
+        return (end_event._ts - self._ts) * 1e3
 
 
 _current_stream = Stream()

@@ -15,6 +15,7 @@ __all__ = [
     "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout", "embedding",
     "one_hot", "label_smooth", "pad", "interpolate", "upsample", "bilinear", "cosine_similarity",
     "pixel_shuffle", "pixel_unshuffle", "channel_shuffle", "fold", "unfold", "zeropad2d",
+    "pdist", "cdist", "sequence_mask", "dice_loss", "temporal_shift",
 ]
 
 
@@ -270,3 +271,95 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name
 
 def zeropad2d(x, padding, data_format="NCHW", name=None):
     return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def pdist(x, p=2.0, name=None):
+    """Pairwise distances of rows — condensed form [n*(n-1)/2]
+    (reference nn/functional/distance.py pdist)."""
+    import numpy as _np
+
+    n = x.shape[0]
+    iu = _np.triu_indices(n, k=1)
+
+    def f(v):
+        d = jnp.linalg.norm(v[:, None, :] - v[None, :, :] + 0.0, ord=p, axis=-1) \
+            if p not in (2, 2.0) else jnp.sqrt(
+                jnp.maximum(((v[:, None, :] - v[None, :, :]) ** 2).sum(-1), 1e-24))
+        return d[iu[0], iu[1]]
+
+    return apply_op(f, "pdist", x)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """[..., n, m] distances between row sets (reference common.py cdist).
+    Euclidean path uses the matmul expansion (MXU-friendly)."""
+
+    def f(a, b):
+        if p in (2, 2.0) and "use_mm" in compute_mode:
+            a2 = (a * a).sum(-1)[..., :, None]
+            b2 = (b * b).sum(-1)[..., None, :]
+            ab = a @ jnp.swapaxes(b, -1, -2)
+            return jnp.sqrt(jnp.maximum(a2 + b2 - 2 * ab, 1e-24))
+        diff = a[..., :, None, :] - b[..., None, :, :]
+        if p == jnp.inf:
+            return jnp.abs(diff).max(-1)
+        return (jnp.abs(diff) ** p).sum(-1) ** (1.0 / p)
+
+    return apply_op(f, "cdist", x, y)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """[..., maxlen] mask with 1 where position < length (reference
+    nn/functional/extension.py sequence_mask)."""
+    import numpy as _np
+
+    if maxlen is None:
+        maxlen = int(_np.asarray(
+            (x._value if hasattr(x, "_value") else x)).max())
+
+    def f(lens):
+        pos = jnp.arange(maxlen)
+        return (pos[None, :] < lens[..., None].astype(jnp.int64)).astype(dtype)
+
+    return apply_op(f, "sequence_mask", x)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """Dice loss over the last (class-prob) axis (reference loss.py dice_loss)."""
+
+    def f(pred, lab):
+        lab_oh = jax.nn.one_hot(lab.squeeze(-1), pred.shape[-1], dtype=pred.dtype)
+        red_axes = tuple(range(1, pred.ndim))
+        inter = (pred * lab_oh).sum(red_axes)
+        union = pred.sum(red_axes) + lab_oh.sum(red_axes)
+        dice = (2 * inter + epsilon) / (union + epsilon)
+        return (1 - dice).mean()
+
+    return apply_op(f, "dice_loss", input, label)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    """TSM temporal shift (reference extension.py temporal_shift): fold the
+    batch into [N//seg, seg], shift the first channels forward in time, the
+    next backward, keep the rest."""
+
+    def f(v):
+        if data_format == "NHWC":
+            v = jnp.moveaxis(v, -1, 1)
+        nt, c, h, w = v.shape
+        n = nt // seg_num
+        v = v.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.concatenate(
+            [v[:, 1:, :fold], jnp.zeros_like(v[:, :1, :fold])], axis=1)
+        right = jnp.concatenate(
+            [jnp.zeros_like(v[:, :1, fold:2 * fold]), v[:, :-1, fold:2 * fold]],
+            axis=1)
+        out = jnp.concatenate([left, right, v[:, :, 2 * fold:]], axis=2)
+        out = out.reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return apply_op(f, "temporal_shift", x)

@@ -10,6 +10,8 @@ from ..tensor import Tensor
 from . import apply_op, binary_op, unary_op
 
 __all__ = [
+    "trace", "take", "vander", "sigmoid", "numel", "is_floating_point",
+    "is_integer", "is_complex",
     # unary
     "abs", "acos", "acosh", "asin", "asinh", "atan", "atanh", "ceil", "cos", "cosh",
     "deg2rad", "rad2deg", "digamma", "erf", "erfinv", "exp", "expm1", "floor", "frac",
@@ -339,3 +341,55 @@ def count_nonzero(x, axis=None, keepdim=False, name=None):
 
 def broadcast_shape(x_shape, y_shape):
     return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    """Reference: python/paddle/tensor/math.py trace."""
+    return apply_op(
+        lambda v: jnp.trace(v, offset=offset, axis1=axis1, axis2=axis2),
+        "trace", x)
+
+
+def take(x, index, mode="raise", name=None):
+    """Reference: python/paddle/tensor/math.py take — flat-index gather with
+    clip/wrap out-of-range modes."""
+    jmode = {"raise": "clip", "clip": "clip", "wrap": "wrap"}[mode]
+    return apply_op(
+        lambda v, i: jnp.take(v.reshape(-1), i, mode=jmode), "take", x, index)
+
+
+def vander(x, n=None, increasing=False, name=None):
+    """Reference: python/paddle/tensor/math.py vander."""
+    def f(v):
+        cols = v.shape[0] if n is None else n
+        powers = jnp.arange(cols)
+        if not increasing:
+            powers = powers[::-1]
+        return v[:, None] ** powers[None, :]
+
+    return apply_op(f, "vander", x)
+
+
+def sigmoid(x, name=None):
+    return apply_op(jax.nn.sigmoid, "sigmoid", x)
+
+
+def numel(x, name=None):
+    from ..tensor import Tensor as _T
+
+    return _T(jnp.asarray(int(np.prod(x.shape)) if x.ndim else 1))
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(jnp.asarray(x._value if hasattr(x, "_value") else x).dtype,
+                          jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(jnp.asarray(x._value if hasattr(x, "_value") else x).dtype,
+                          jnp.integer)
+
+
+def is_complex(x):
+    return jnp.issubdtype(jnp.asarray(x._value if hasattr(x, "_value") else x).dtype,
+                          jnp.complexfloating)

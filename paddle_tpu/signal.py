@@ -1,0 +1,95 @@
+"""paddle.signal — STFT / iSTFT.
+
+Reference: python/paddle/signal.py (stft:153, istft:305). Framing is a strided
+gather + batched rfft/fft (XLA FFT); istft does the standard overlap-add with
+window-envelope normalization.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .audio.functional import get_window
+from .ops import apply_op
+from .tensor import Tensor
+
+
+def _prep_window(window, win_length, n_fft, dtype=jnp.float32):
+    if window is None:
+        w = jnp.ones((win_length,), dtype)
+    elif isinstance(window, Tensor):
+        w = window._value.astype(dtype)
+    elif isinstance(window, str) or isinstance(window, (tuple, list)):
+        w = get_window(window, win_length)._value.astype(dtype)
+    else:
+        w = jnp.asarray(window, dtype)
+    if win_length < n_fft:
+        lp = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lp, n_fft - win_length - lp))
+    return w
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+         pad_mode="reflect", normalized=False, onesided=True, name=None):
+    """x: [..., T] → complex [..., n_fft//2+1 (or n_fft), n_frames]."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    w = _prep_window(window, win_length, n_fft)
+
+    def f(v):
+        v = v.astype(jnp.float32)
+        if center:
+            pad = n_fft // 2
+            cfg = [(0, 0)] * (v.ndim - 1) + [(pad, pad)]
+            v = jnp.pad(v, cfg, mode=pad_mode)
+        t = v.shape[-1]
+        n_frames = 1 + (t - n_fft) // hop_length
+        idx = (jnp.arange(n_frames)[:, None] * hop_length
+               + jnp.arange(n_fft)[None, :])
+        frames = v[..., idx] * w
+        spec = jnp.fft.rfft(frames, axis=-1) if onesided else \
+            jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.float32(n_fft))
+        return jnp.swapaxes(spec, -1, -2)  # [..., freq, frames]
+
+    return apply_op(f, "stft", x)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+          normalized=False, onesided=True, length=None, return_complex=False,
+          name=None):
+    """Inverse STFT via overlap-add; x: [..., freq, n_frames]."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    w = _prep_window(window, win_length, n_fft)
+
+    def f(v):
+        spec = jnp.swapaxes(v, -1, -2)  # [..., frames, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.float32(n_fft))
+        frames = (jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided
+                  else jnp.fft.ifft(spec, axis=-1).real)
+        frames = frames * w
+        n_frames = frames.shape[-2]
+        out_len = n_fft + hop_length * (n_frames - 1)
+        lead = frames.shape[:-2]
+        flat = frames.reshape((-1, n_frames, n_fft))
+        idx = (jnp.arange(n_frames)[:, None] * hop_length
+               + jnp.arange(n_fft)[None, :]).reshape(-1)
+
+        def ola(fr):
+            sig = jnp.zeros((out_len,), fr.dtype).at[idx].add(fr.reshape(-1))
+            return sig
+
+        sig = jax.vmap(ola)(flat).reshape(lead + (out_len,))
+        env = jnp.zeros((out_len,), w.dtype).at[idx].add(
+            jnp.tile(w * w, (n_frames,)))
+        sig = sig / jnp.maximum(env, 1e-11)
+        if center:
+            sig = sig[..., n_fft // 2: out_len - n_fft // 2]
+        if length is not None:
+            sig = sig[..., :length]
+        return sig
+
+    return apply_op(f, "istft", x)

@@ -1,0 +1,128 @@
+"""device memory API, Event timing, signal.stft/istft, and the op fill-ins
+(trace/take/vander/numel, pdist/cdist/sequence_mask/dice_loss/temporal_shift)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+# ------------------------------------------------------------------ device
+def test_memory_api():
+    x = paddle.to_tensor(np.ones((256, 256), "float32"))
+    stats = paddle.device.memory_stats()
+    assert isinstance(stats, dict)
+    allocated = paddle.device.memory_allocated()
+    assert allocated >= x._value.nbytes
+    assert paddle.device.max_memory_allocated() >= 0
+    assert paddle.device.memory_reserved() >= 0
+    paddle.device.empty_cache()
+
+
+def test_event_timing():
+    import time
+
+    a, b = paddle.device.Event(), paddle.device.Event()
+    a.record()
+    time.sleep(0.01)
+    b.record()
+    assert a.elapsed_time(b) >= 8.0
+    with pytest.raises(RuntimeError):
+        paddle.device.Event().elapsed_time(paddle.device.Event())
+
+
+# ------------------------------------------------------------------ math fill-ins
+def test_trace_take_vander_numel():
+    x = np.arange(9, dtype="float32").reshape(3, 3)
+    assert float(paddle.trace(paddle.to_tensor(x)).numpy()) == np.trace(x)
+    idx = np.array([0, 4, 8])
+    np.testing.assert_array_equal(
+        np.asarray(paddle.take(paddle.to_tensor(x), paddle.to_tensor(idx))._value),
+        x.reshape(-1)[idx])
+    v = np.array([1.0, 2.0, 3.0], "float32")
+    np.testing.assert_allclose(
+        np.asarray(paddle.vander(paddle.to_tensor(v))._value), np.vander(v))
+    np.testing.assert_allclose(
+        np.asarray(paddle.vander(paddle.to_tensor(v), n=2, increasing=True)._value),
+        np.vander(v, 2, increasing=True))
+    assert int(paddle.numel(paddle.to_tensor(x)).numpy()) == 9
+    assert paddle.is_floating_point(paddle.to_tensor(x))
+    assert paddle.is_integer(paddle.to_tensor(idx))
+    np.testing.assert_allclose(
+        np.asarray(paddle.sigmoid(paddle.to_tensor(v))._value),
+        1 / (1 + np.exp(-v)), rtol=1e-6)
+
+
+# ------------------------------------------------------------------ signal
+def test_stft_istft_roundtrip():
+    rng = np.random.default_rng(0)
+    sig = rng.standard_normal((2, 2048)).astype("float32")
+    spec = paddle.signal.stft(paddle.to_tensor(sig), n_fft=256, hop_length=64,
+                              window="hann")
+    assert spec._value.shape == (2, 129, 2048 // 64 + 1)
+    back = paddle.signal.istft(spec, n_fft=256, hop_length=64, window="hann",
+                               length=2048)
+    np.testing.assert_allclose(np.asarray(back._value), sig, atol=1e-3)
+
+
+def test_stft_matches_manual_dft():
+    t = np.linspace(0, 1, 512, endpoint=False).astype("float32")
+    sig = np.sin(2 * np.pi * 64 * t)
+    spec = paddle.signal.stft(paddle.to_tensor(sig[None]), n_fft=128,
+                              hop_length=128, window=None, center=False)
+    mag = np.abs(np.asarray(spec._value))[0]
+    peak = mag.mean(-1).argmax()
+    assert peak == 16  # 64 Hz → bin 64/(512/128) = 16
+
+
+# ------------------------------------------------------------------ F fill-ins
+def test_pdist_cdist():
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((5, 3)).astype("float32")
+    y = rng.standard_normal((4, 3)).astype("float32")
+    got = np.asarray(F.pdist(paddle.to_tensor(x))._value)
+    want = []
+    for i in range(5):
+        for j in range(i + 1, 5):
+            want.append(np.linalg.norm(x[i] - x[j]))
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+    got_c = np.asarray(F.cdist(paddle.to_tensor(x), paddle.to_tensor(y))._value)
+    want_c = np.linalg.norm(x[:, None] - y[None], axis=-1)
+    np.testing.assert_allclose(got_c, want_c, rtol=1e-3, atol=1e-4)
+    got1 = np.asarray(F.cdist(paddle.to_tensor(x), paddle.to_tensor(y),
+                              p=1.0, compute_mode="donot")._value)
+    np.testing.assert_allclose(got1, np.abs(x[:, None] - y[None]).sum(-1),
+                               rtol=1e-5)
+
+
+def test_sequence_mask():
+    lens = paddle.to_tensor(np.array([1, 3, 0], "int64"))
+    m = np.asarray(F.sequence_mask(lens, maxlen=4)._value)
+    np.testing.assert_array_equal(
+        m, [[1, 0, 0, 0], [1, 1, 1, 0], [0, 0, 0, 0]])
+    m2 = np.asarray(F.sequence_mask(lens)._value)
+    assert m2.shape == (3, 3)
+
+
+def test_dice_loss():
+    pred = np.array([[[0.9, 0.1], [0.2, 0.8]]], "float32")  # [1, 2, 2]
+    label = np.array([[[0], [1]]], "int64")
+    loss = float(F.dice_loss(paddle.to_tensor(pred),
+                             paddle.to_tensor(label)).numpy())
+    assert 0 <= loss < 0.3  # predictions match labels: small loss
+
+
+def test_temporal_shift():
+    nt, c, h, w = 4, 8, 2, 2
+    x = np.arange(nt * c * h * w, dtype="float32").reshape(nt, c, h, w)
+    out = np.asarray(F.temporal_shift(paddle.to_tensor(x), seg_num=2,
+                                      shift_ratio=0.25)._value)
+    assert out.shape == x.shape
+    v = x.reshape(2, 2, c, h, w)
+    # first quarter of channels shifted forward: out[t] = in[t+1], last t zero
+    np.testing.assert_array_equal(out.reshape(2, 2, c, h, w)[:, 0, :2],
+                                  v[:, 1, :2])
+    assert np.all(out.reshape(2, 2, c, h, w)[:, 1, :2] == 0)
+    # untouched remainder
+    np.testing.assert_array_equal(out.reshape(2, 2, c, h, w)[:, :, 4:],
+                                  v[:, :, 4:])
