@@ -13,6 +13,8 @@ from ..ops import apply_op
 from ..tensor import Tensor
 
 __all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "Exponential", "Laplace", "Gumbel", "Beta", "Gamma", "Dirichlet",
+           "LogNormal", "Geometric", "Poisson", "Multinomial",
            "kl_divergence", "register_kl"]
 
 
@@ -178,3 +180,351 @@ def kl_divergence(p, q):
     if hasattr(p, "kl_divergence"):
         return p.kl_divergence(q)
     raise NotImplementedError(f"no KL registered for {type(p)} vs {type(q)}")
+
+
+class Exponential(Distribution):
+    """Reference: distribution/exponential.py."""
+
+    def __init__(self, rate, name=None):
+        self.rate = _val(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return Tensor(1.0 / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(1.0 / jnp.square(self.rate))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.exponential(_rng.next_key(), shape) / self.rate)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def f(v):
+            return jnp.where(v >= 0, jnp.log(self.rate) - self.rate * v, -jnp.inf)
+
+        return apply_op(f, "exponential_log_prob", value)
+
+    def entropy(self):
+        return Tensor(1.0 - jnp.log(self.rate))
+
+    def kl_divergence(self, other):
+        r = self.rate / other.rate
+        return Tensor(jnp.log(r) + other.rate / self.rate - 1.0)
+
+
+class Laplace(Distribution):
+    """Reference: distribution/laplace.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(np.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self._batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(2 * jnp.square(self.scale),
+                                       self._batch_shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.laplace(_rng.next_key(), shape) * self.scale
+                      + self.loc)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def f(v):
+            return -jnp.abs(v - self.loc) / self.scale - jnp.log(2 * self.scale)
+
+        return apply_op(f, "laplace_log_prob", value)
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(1 + jnp.log(2 * self.scale),
+                                       self._batch_shape))
+
+
+class Gumbel(Distribution):
+    """Reference: distribution/gumbel.py."""
+
+    EULER = 0.57721566490153286
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(np.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc + self.EULER * self.scale,
+                                       self._batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(
+            (math.pi ** 2 / 6) * jnp.square(self.scale), self._batch_shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.gumbel(_rng.next_key(), shape) * self.scale
+                      + self.loc)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def f(v):
+            z = (v - self.loc) / self.scale
+            return -(z + jnp.exp(-z)) - jnp.log(self.scale)
+
+        return apply_op(f, "gumbel_log_prob", value)
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(jnp.log(self.scale) + 1 + self.EULER,
+                                       self._batch_shape))
+
+
+class Beta(Distribution):
+    """Reference: distribution/beta.py."""
+
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _val(alpha)
+        self.beta = _val(beta)
+        super().__init__(np.broadcast_shapes(self.alpha.shape, self.beta.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return Tensor(self.alpha * self.beta / (jnp.square(s) * (s + 1)))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.beta(_rng.next_key(), self.alpha, self.beta,
+                                      shape))
+
+    def log_prob(self, value):
+        def f(v):
+            from jax.scipy.special import betaln
+
+            return ((self.alpha - 1) * jnp.log(v) + (self.beta - 1)
+                    * jnp.log1p(-v) - betaln(self.alpha, self.beta))
+
+        return apply_op(f, "beta_log_prob", value)
+
+    def entropy(self):
+        from jax.scipy.special import betaln, digamma
+
+        a, b = self.alpha, self.beta
+        return Tensor(betaln(a, b) - (a - 1) * digamma(a) - (b - 1) * digamma(b)
+                      + (a + b - 2) * digamma(a + b))
+
+
+class Gamma(Distribution):
+    """Reference: distribution/gamma.py."""
+
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _val(concentration)
+        self.rate = _val(rate)
+        super().__init__(np.broadcast_shapes(self.concentration.shape,
+                                             self.rate.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.concentration / jnp.square(self.rate))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.gamma(_rng.next_key(), self.concentration,
+                                       shape) / self.rate)
+
+    def log_prob(self, value):
+        def f(v):
+            from jax.scipy.special import gammaln
+
+            a, r = self.concentration, self.rate
+            return (a * jnp.log(r) + (a - 1) * jnp.log(v) - r * v - gammaln(a))
+
+        return apply_op(f, "gamma_log_prob", value)
+
+    def entropy(self):
+        from jax.scipy.special import digamma, gammaln
+
+        a, r = self.concentration, self.rate
+        return Tensor(a - jnp.log(r) + gammaln(a) + (1 - a) * digamma(a))
+
+
+class Dirichlet(Distribution):
+    """Reference: distribution/dirichlet.py."""
+
+    def __init__(self, concentration, name=None):
+        self.concentration = _val(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration
+                      / self.concentration.sum(-1, keepdims=True))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.dirichlet(_rng.next_key(), self.concentration,
+                                           shape))
+
+    def log_prob(self, value):
+        def f(v):
+            from jax.scipy.special import gammaln
+
+            a = self.concentration
+            return (((a - 1) * jnp.log(v)).sum(-1) + gammaln(a.sum(-1))
+                    - gammaln(a).sum(-1))
+
+        return apply_op(f, "dirichlet_log_prob", value)
+
+
+class LogNormal(Distribution):
+    """Reference: distribution/lognormal.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(np.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.exp(self.loc + jnp.square(self.scale) / 2))
+
+    @property
+    def variance(self):
+        s2 = jnp.square(self.scale)
+        return Tensor((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        z = jax.random.normal(_rng.next_key(), shape)
+        return Tensor(jnp.exp(self.loc + self.scale * z))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def f(v):
+            lv = jnp.log(v)
+            return (-jnp.square(lv - self.loc) / (2 * jnp.square(self.scale))
+                    - jnp.log(self.scale * v) - 0.5 * math.log(2 * math.pi))
+
+        return apply_op(f, "lognormal_log_prob", value)
+
+    def entropy(self):
+        return Tensor(self.loc + 0.5 * math.log(2 * math.pi * math.e)
+                      + jnp.log(self.scale) + jnp.zeros(self._batch_shape))
+
+
+class Geometric(Distribution):
+    """Reference: distribution/geometric.py (support {0, 1, 2, ...})."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _val(probs)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return Tensor((1 - self.probs) / self.probs)
+
+    @property
+    def variance(self):
+        return Tensor((1 - self.probs) / jnp.square(self.probs))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        u = jax.random.uniform(_rng.next_key(), shape, minval=1e-7, maxval=1.0)
+        return Tensor(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs)))
+
+    def log_prob(self, value):
+        def f(v):
+            return v * jnp.log1p(-self.probs) + jnp.log(self.probs)
+
+        return apply_op(f, "geometric_log_prob", value)
+
+    def entropy(self):
+        p = self.probs
+        return Tensor(-((1 - p) * jnp.log1p(-p) + p * jnp.log(p)) / p)
+
+
+class Poisson(Distribution):
+    """Reference: distribution/poisson.py."""
+
+    def __init__(self, rate, name=None):
+        self.rate = _val(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.rate, self._batch_shape))
+
+    variance = mean
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.poisson(_rng.next_key(), self.rate,
+                                         shape).astype(jnp.float32))
+
+    def log_prob(self, value):
+        def f(v):
+            from jax.scipy.special import gammaln
+
+            return v * jnp.log(self.rate) - self.rate - gammaln(v + 1)
+
+        return apply_op(f, "poisson_log_prob", value)
+
+
+class Multinomial(Distribution):
+    """Reference: distribution/multinomial.py."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _val(probs)
+        super().__init__(self.probs.shape[:-1], self.probs.shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        logits = jnp.log(jnp.maximum(self.probs, 1e-37))
+        draws = jax.random.categorical(
+            _rng.next_key(), logits, axis=-1,
+            shape=(self.total_count,) + shape)
+        k = self.probs.shape[-1]
+        onehot = jax.nn.one_hot(draws, k)
+        return Tensor(onehot.sum(0))
+
+    def log_prob(self, value):
+        def f(v):
+            from jax.scipy.special import gammaln
+
+            logp = (v * jnp.log(jnp.maximum(self.probs, 1e-37))).sum(-1)
+            coeff = gammaln(jnp.float32(self.total_count + 1)) - \
+                gammaln(v + 1).sum(-1)
+            return coeff + logp
+
+        return apply_op(f, "multinomial_log_prob", value)
