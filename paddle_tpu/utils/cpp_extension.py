@@ -1,0 +1,146 @@
+"""Custom operator system.
+
+Reference: python/paddle/utils/cpp_extension/ (load() JIT-compiles C++ sources
+into an importable op library) and the custom-op registration machinery
+(paddle/fluid/framework/custom_operator.cc).
+
+TPU-native split:
+
+- **Device custom ops** are Pallas/jax functions — ``register_op`` puts them
+  behind the same ``apply_op`` dispatch as every built-in (autograd via
+  jax.vjp, optional custom vjp, works under jit/GSPMD). This is the path that
+  runs on the MXU.
+- **Host custom ops** are real native code: ``load()`` compiles C++ sources
+  with g++ into a shared library and exposes ``extern "C"`` functions through
+  ctypes. They run on host buffers (the reference's CPU-kernel custom ops);
+  useful for data-loader transforms and CPU pre/post-processing, and they
+  compose with the op layer through ``lib.elementwise`` wrappers.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+
+from ..ops import apply_op
+from ..tensor import Tensor
+
+_BUILD_ROOT = os.path.join(tempfile.gettempdir(), "paddle_tpu_extensions")
+
+
+# ------------------------------------------------------------------ device ops
+_CUSTOM_OPS: dict = {}
+
+
+def register_op(name, fn, vjp=None):
+    """Register a jax/Pallas function as a paddle op.
+
+    fn(*jax_arrays, **static_kwargs) -> jax array(s). Optional
+    vjp(primals, cotangents) -> input cotangents installs a custom gradient
+    (jax.custom_vjp); otherwise jax differentiates fn directly.
+    Returns the dispatchable callable (also available via ``get_op(name)``).
+    """
+    import jax
+
+    if vjp is not None:
+        wrapped = jax.custom_vjp(fn)
+
+        def fwd(*args, **kw):
+            return fn(*args, **kw), args
+
+        def bwd(primals, ct):
+            return tuple(vjp(primals, ct))
+
+        wrapped.defvjp(fwd, bwd)
+        impl = wrapped
+    else:
+        impl = fn
+
+    def dispatch(*tensors, **kwargs):
+        return apply_op(impl, name, *tensors, **kwargs)
+
+    dispatch.__name__ = name
+    _CUSTOM_OPS[name] = dispatch
+    return dispatch
+
+
+def get_op(name):
+    return _CUSTOM_OPS[name]
+
+
+# ------------------------------------------------------------------ host ops
+_C_DTYPES = {
+    np.dtype("float32"): ctypes.c_float,
+    np.dtype("float64"): ctypes.c_double,
+    np.dtype("int32"): ctypes.c_int32,
+    np.dtype("int64"): ctypes.c_int64,
+}
+
+
+class CustomOpLibrary:
+    """A compiled extension: ctypes handle + paddle-level helpers."""
+
+    def __init__(self, name, so_path):
+        self.name = name
+        self.so_path = so_path
+        self._lib = ctypes.CDLL(so_path)
+
+    def __getattr__(self, fn_name):
+        return getattr(self._lib, fn_name)
+
+    def elementwise(self, fn_name, x, out_dtype=None):
+        """Run ``void fn(const T* in, T* out, int64_t n)`` over a tensor's host
+        copy; returns a new Tensor. The convention covers map-style host ops."""
+        arr = np.ascontiguousarray(
+            np.asarray(x._value if isinstance(x, Tensor) else x))
+        ctype = _C_DTYPES.get(arr.dtype)
+        if ctype is None:
+            raise TypeError(f"unsupported dtype {arr.dtype} for host custom op")
+        out = np.empty_like(arr, dtype=out_dtype or arr.dtype)
+        fn = getattr(self._lib, fn_name)
+        fn.argtypes = [ctypes.POINTER(ctype),
+                       ctypes.POINTER(_C_DTYPES[out.dtype]),
+                       ctypes.c_int64]
+        fn.restype = None
+        fn(arr.ctypes.data_as(ctypes.POINTER(ctype)),
+           out.ctypes.data_as(ctypes.POINTER(_C_DTYPES[out.dtype])),
+           ctypes.c_int64(arr.size))
+        import jax.numpy as jnp
+
+        return Tensor(jnp.asarray(out))
+
+
+def load(name, sources, extra_cxx_flags=(), extra_ldflags=(), build_directory=None,
+         verbose=False):
+    """JIT-compile C++ `sources` into a shared library (reference
+    cpp_extension.load). Caches on (sources content, flags)."""
+    build_dir = build_directory or _BUILD_ROOT
+    os.makedirs(build_dir, exist_ok=True)
+    h = hashlib.sha256()
+    for s in sources:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    h.update(" ".join([*extra_cxx_flags, *extra_ldflags]).encode())
+    so_path = os.path.join(build_dir, f"{name}_{h.hexdigest()[:16]}.so")
+    if not os.path.exists(so_path):
+        cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
+               *extra_cxx_flags, *sources, "-o", so_path, *extra_ldflags]
+        if verbose:
+            print("[cpp_extension]", " ".join(cmd))
+        r = subprocess.run(cmd, capture_output=True, text=True)
+        if r.returncode != 0:
+            raise RuntimeError(f"compilation of {name} failed:\n{r.stderr}")
+    return CustomOpLibrary(name, so_path)
+
+
+class CppExtension:
+    """setup()-style spec (reference cpp_extension.CppExtension) — thin data
+    holder; `load` is the JIT path used in this build."""
+
+    def __init__(self, sources, *args, **kwargs):
+        self.sources = sources
+        self.kwargs = kwargs

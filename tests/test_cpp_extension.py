@@ -1,0 +1,92 @@
+"""Custom op system: native host ops via g++/ctypes, device ops via register_op."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils import cpp_extension
+
+
+def test_host_cpp_op_compiles_and_runs(tmp_path):
+    src = tmp_path / "ops.cc"
+    src.write_text("""
+    #include <cstdint>
+    #include <cmath>
+    extern "C" void fast_gelu(const float* x, float* y, int64_t n) {
+        for (int64_t i = 0; i < n; ++i) {
+            float v = x[i];
+            y[i] = 0.5f * v * (1.0f + std::tanh(0.7978845608f *
+                                                (v + 0.044715f * v * v * v)));
+        }
+    }
+    extern "C" void square_i64(const int64_t* x, int64_t* y, int64_t n) {
+        for (int64_t i = 0; i < n; ++i) y[i] = x[i] * x[i];
+    }
+    """)
+    lib = cpp_extension.load("test_ops", [str(src)], build_directory=str(tmp_path))
+    x = np.linspace(-3, 3, 64).astype("float32")
+    out = lib.elementwise("fast_gelu", paddle.to_tensor(x))
+    want = 0.5 * x * (1 + np.tanh(0.7978845608 * (x + 0.044715 * x**3)))
+    np.testing.assert_allclose(np.asarray(out._value), want, rtol=1e-4, atol=1e-6)
+
+    xi = np.arange(8, dtype="int64")
+    got = lib.elementwise("square_i64", paddle.to_tensor(xi))
+    np.testing.assert_array_equal(np.asarray(got._value), xi * xi)
+
+    # cache: second load reuses the .so
+    lib2 = cpp_extension.load("test_ops", [str(src)], build_directory=str(tmp_path))
+    assert lib2.so_path == lib.so_path
+
+
+def test_compile_error_surfaces(tmp_path):
+    bad = tmp_path / "bad.cc"
+    bad.write_text("this is not C++")
+    with pytest.raises(RuntimeError, match="compilation"):
+        cpp_extension.load("bad_ops", [str(bad)], build_directory=str(tmp_path))
+
+
+def test_register_device_op_with_autograd():
+    import jax.numpy as jnp
+
+    op = cpp_extension.register_op("my_softsign", lambda v: v / (1 + jnp.abs(v)))
+    x = paddle.to_tensor(np.array([-2.0, 0.0, 2.0], "float32"),
+                         stop_gradient=False)
+    y = op(x)
+    np.testing.assert_allclose(np.asarray(y._value),
+                               [-2 / 3, 0.0, 2 / 3], rtol=1e-6)
+    y.sum().backward()
+    # d/dx x/(1+|x|) = 1/(1+|x|)^2
+    np.testing.assert_allclose(np.asarray(x.grad), [1 / 9, 1.0, 1 / 9], rtol=1e-5)
+    assert cpp_extension.get_op("my_softsign") is op
+
+
+def test_register_device_op_with_custom_vjp():
+    import jax.numpy as jnp
+
+    # clipped-identity with a straight-through custom gradient
+    def fwd(v):
+        return jnp.clip(v, -1.0, 1.0)
+
+    def vjp(primals, ct):
+        return (ct[0] if isinstance(ct, (tuple, list)) else ct,)  # pass-through
+
+    op = cpp_extension.register_op("ste_clip", fwd, vjp=vjp)
+    x = paddle.to_tensor(np.array([-3.0, 0.5, 3.0], "float32"),
+                         stop_gradient=False)
+    y = op(x)
+    np.testing.assert_allclose(np.asarray(y._value), [-1.0, 0.5, 1.0])
+    y.sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad), [1.0, 1.0, 1.0])
+
+
+def test_registered_op_works_under_jit():
+    import jax
+    import jax.numpy as jnp
+
+    op = cpp_extension.register_op("jit_double", lambda v: v * 2)
+
+    @paddle.jit.to_static
+    def f(t):
+        return op(t) + 1
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+    np.testing.assert_allclose(np.asarray(f(x)._value), [3.0, 5.0])
