@@ -24,6 +24,19 @@ __all__ = [
 
 _sdp_config = {"enable_flash": True, "enable_math": True, "enable_mem_efficient": True}
 
+# Which implementation served the LAST attention call in this process —
+# "pallas" (Mosaic kernel) or "xla" (fused softmax(QK^T)V). Fallbacks used to
+# be silent (round-2 finding); tests and users can now assert the path.
+_last_backend = {"name": None}
+
+
+def get_last_attention_backend():
+    return _last_backend["name"]
+
+
+def _mark(name):
+    _last_backend["name"] = name
+
 
 @contextlib.contextmanager
 def sdp_kernel(enable_flash=True, enable_math=True, enable_mem_efficient=True):
@@ -94,12 +107,14 @@ def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax
     if _use_pallas(tuple(query.shape), tuple(key.shape)) and not dropout:
         from ...ops.pallas.flash_attention import flash_attention as _pallas_fa
 
+        _mark("pallas")
         out = apply_op(
             lambda q, k, v: _pallas_fa(q, k, v, causal=causal, scale=scale),
             "flash_attention_pallas", query, key, value,
         )
         return out, None
 
+    _mark("xla")
     out = apply_op(
         lambda q, k, v: _sdpa_core(q, k, v, None, scale, causal, dropout, training),
         "flash_attention", query, key, value,
@@ -111,9 +126,68 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k, max_seqle
                         max_seqlen_k, scale, dropout=0.0, causal=False,
                         return_softmax=False, fixed_seed_offset=None, rng_name="",
                         training=True, name=None):
-    """Varlen attention (reference :756): tokens packed as [total, heads, dim] with
-    cu_seqlens boundaries. TPU-native: segment-mask over one padded batch — static
-    shapes, no dynamic slicing."""
+    """Varlen attention (reference :756): tokens packed as [total, heads, dim]
+    with cu_seqlens boundaries.
+
+    TPU paths (check get_last_attention_backend()):
+    - pallas: the packed sequence is ONE flashmask batch — per-column document
+      bounds from cu_seqlens become startend_row_indices, so the kernel skips
+      cross-document blocks and never materializes [total, total] scores.
+      Requires total % 128 == 0 (kernel block) — the wrapper pads with a fully
+      masked tail (masked rows produce exact zeros) and slices it off.
+    - xla fallback: segment-mask over the full score matrix (fine for short
+      totals; memory-bound for long ones).
+    """
+    q_len = int(query.shape[0])
+    _block = 128
+    _total = q_len + ((-q_len) % _block)
+    same_qk = (query.shape[0] == key.shape[0])
+    if (same_qk and not dropout
+            and _use_pallas((1, _total, query.shape[1], query.shape[2]),
+                            (1, _total, key.shape[1], key.shape[2]))):
+        from ...ops.pallas.flash_attention import (
+            flashmask_attention as _pallas_fm,
+        )
+
+        block = 128
+        pad = (-q_len) % block
+        total = q_len + pad
+
+        def fp(q, k, v, cu_k):
+            cu = cu_k.astype(jnp.int32)
+            seg = jnp.cumsum(
+                jnp.zeros(q_len, jnp.int32).at[cu[1:-1]].add(1))
+            doc_end = jnp.take(cu, seg + 1)        # [q_len] per-column doc end
+            doc_start = jnp.take(cu, seg)
+            if pad:
+                cfg = [(0, pad)] + [(0, 0)] * (q.ndim - 1)
+                q = jnp.pad(q, cfg)
+                k = jnp.pad(k, cfg)
+                v = jnp.pad(v, cfg)
+                doc_end = jnp.pad(doc_end, (0, pad))     # end=0: all rows masked
+                doc_start = jnp.pad(doc_start, (0, pad))
+            qb = q[None]  # [1, total, H, D]
+            kb = k[None]
+            vb = v[None]
+            if causal:
+                # LT mask per column: rows >= doc_end are other documents
+                sri = doc_end[None, None, :, None]
+            else:
+                # mask rows outside [doc_start, doc_end): lower [end, total),
+                # upper [0, start)
+                sri = jnp.stack(
+                    [doc_end, jnp.full_like(doc_end, total),
+                     jnp.zeros_like(doc_end), doc_start], -1)[None, None]
+            out = _pallas_fm(qb, kb, vb, sri.astype(jnp.int32),
+                             causal=causal, scale=scale)  # [1, total, H, D]
+            return out[0, :q_len]
+
+        _mark("pallas")
+        out = apply_op(fp, "flash_attn_unpadded_pallas", query, key, value,
+                       cu_seqlens_k)
+        return out, None
+
+    _mark("xla")
 
     def f(q, k, v, cu_q, cu_k):
         total_q = q.shape[0]
@@ -152,6 +226,7 @@ def flashmask_attention(query, key, value, startend_row_indices=None, dropout=0.
             and _use_pallas(tuple(query.shape), tuple(key.shape))):
         from ...ops.pallas.flash_attention import flashmask_attention as _pallas_fm
 
+        _mark("pallas")
         out = apply_op(
             lambda q, k, v, sri: _pallas_fm(q, k, v, sri, causal=causal, scale=scale),
             "flashmask_attention_pallas", query, key, value, startend_row_indices,
@@ -160,6 +235,8 @@ def flashmask_attention(query, key, value, startend_row_indices=None, dropout=0.
             extras = [None] * (int(return_softmax_lse) + int(return_seed_offset))
             return (out, *extras)
         return out
+
+    _mark("xla")
 
     def f(q, k, v, sri):
         B, S = q.shape[0], q.shape[1]

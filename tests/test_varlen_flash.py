@@ -1,0 +1,99 @@
+"""Varlen flash attention through the Pallas flashmask path (round-2 weak #10:
+varlen previously used only the naive path, and fallbacks were silent)."""
+import numpy as np
+import pytest
+
+import importlib
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+# the submodule is shadowed by the function of the same name in the package
+FA = importlib.import_module("paddle_tpu.nn.functional.flash_attention")
+
+
+def _varlen_inputs(rng, lens, h=4, d=32):
+    total = sum(lens)
+    q = rng.standard_normal((total, h, d)).astype("float32")
+    cu = np.concatenate([[0], np.cumsum(lens)]).astype("int64")
+    return q, cu, total
+
+
+def _naive_reference(q, k, v, cu, scale, causal):
+    out = np.zeros_like(q)
+    for i in range(len(cu) - 1):
+        s, e = cu[i], cu[i + 1]
+        qs, ks, vs = q[s:e], k[s:e], v[s:e]
+        scores = np.einsum("qhd,khd->hqk", qs, ks) * scale
+        if causal:
+            t = e - s
+            mask = np.tril(np.ones((t, t), bool))
+            scores = np.where(mask[None], scores, -np.inf)
+        m = scores.max(-1, keepdims=True)
+        p = np.exp(scores - m)
+        p = p / p.sum(-1, keepdims=True)
+        out[s:e] = np.einsum("hqk,khd->qhd", p, vs)
+    return out
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_varlen_pallas_matches_naive(monkeypatch, causal):
+    """Force the pallas route (interpret-mode kernel on CPU) and compare with a
+    per-document numpy reference."""
+    monkeypatch.setattr(FA, "_use_pallas", lambda qs, ks: True)
+    rng = np.random.default_rng(0)
+    lens = [96, 32, 128]   # total 256 = 2 kernel blocks
+    q, cu, total = _varlen_inputs(rng, lens)
+    k = rng.standard_normal(q.shape).astype("float32")
+    v = rng.standard_normal(q.shape).astype("float32")
+    scale = 1.0 / np.sqrt(q.shape[-1])
+
+    out, _ = F.flash_attn_unpadded(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        paddle.to_tensor(cu), paddle.to_tensor(cu), max(lens), max(lens),
+        scale=scale, causal=causal)
+    assert FA.get_last_attention_backend() == "pallas"
+    want = _naive_reference(q, k, v, cu, scale, causal)
+    np.testing.assert_allclose(np.asarray(out._value), want, rtol=2e-3,
+                               atol=2e-3)
+
+
+def test_varlen_pallas_pads_indivisible_total(monkeypatch):
+    monkeypatch.setattr(FA, "_use_pallas", lambda qs, ks: True)
+    rng = np.random.default_rng(1)
+    lens = [100, 60]       # total 160: needs padding to 256
+    q, cu, total = _varlen_inputs(rng, lens)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    out, _ = F.flash_attn_unpadded(
+        paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+        paddle.to_tensor(cu), paddle.to_tensor(cu), max(lens), max(lens),
+        scale=scale, causal=True)
+    assert tuple(out.shape) == (160, 4, 32)
+    want = _naive_reference(q, q, q, cu, scale, True)
+    np.testing.assert_allclose(np.asarray(out._value), want, rtol=2e-3,
+                               atol=2e-3)
+
+
+def test_varlen_gradients_flow_through_pallas(monkeypatch):
+    monkeypatch.setattr(FA, "_use_pallas", lambda qs, ks: True)
+    rng = np.random.default_rng(2)
+    q, cu, total = _varlen_inputs(rng, [128, 128])
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    t = paddle.to_tensor(q, stop_gradient=False)
+    out, _ = F.flash_attn_unpadded(
+        t, paddle.to_tensor(q), paddle.to_tensor(q),
+        paddle.to_tensor(cu), paddle.to_tensor(cu), 128, 128,
+        scale=scale, causal=True)
+    out.sum().backward()
+    g = np.asarray(t.grad)
+    assert np.all(np.isfinite(g)) and np.any(g != 0)
+
+
+def test_backend_marker_reports_fallback():
+    rng = np.random.default_rng(3)
+    q, cu, _ = _varlen_inputs(rng, [16, 16])
+    out, _ = F.flash_attn_unpadded(
+        paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+        paddle.to_tensor(cu), paddle.to_tensor(cu), 16, 16,
+        scale=0.2, causal=True)
+    assert FA.get_last_attention_backend() == "xla"  # short: naive path
