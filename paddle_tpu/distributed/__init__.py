@@ -25,6 +25,7 @@ from .context_parallel import (  # noqa: F401
 )
 from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import rpc  # noqa: F401
 from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
 
 # aliases used in reference code

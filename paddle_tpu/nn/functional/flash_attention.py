@@ -51,6 +51,22 @@ def sdp_kernel(enable_flash=True, enable_math=True, enable_mem_efficient=True):
         _sdp_config.update(prev)
 
 
+def _same_cu(cu_q, cu_k):
+    """True iff the q and k segment boundaries are PROVABLY identical — the
+    pallas varlen route masks by k-documents only, which is wrong for
+    cross-attention with different boundaries (fall back to XLA there)."""
+    if cu_q is cu_k:
+        return True
+    a = cu_q._value if isinstance(cu_q, Tensor) else cu_q
+    b = cu_k._value if isinstance(cu_k, Tensor) else cu_k
+    if isinstance(a, jax.core.Tracer) or isinstance(b, jax.core.Tracer):
+        return False
+    import numpy as _np
+
+    a, b = _np.asarray(a), _np.asarray(b)
+    return a.shape == b.shape and bool((a == b).all())
+
+
 def _use_pallas(q_shape, k_shape) -> bool:
     if not _sdp_config["enable_flash"]:
         return False
@@ -139,19 +155,17 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k, max_seqle
       totals; memory-bound for long ones).
     """
     q_len = int(query.shape[0])
-    _block = 128
-    _total = q_len + ((-q_len) % _block)
-    same_qk = (query.shape[0] == key.shape[0])
+    block = 128
+    pad = (-q_len) % block
+    total = q_len + pad
+    same_qk = (query.shape[0] == key.shape[0]) and _same_cu(cu_seqlens_q,
+                                                            cu_seqlens_k)
     if (same_qk and not dropout
-            and _use_pallas((1, _total, query.shape[1], query.shape[2]),
-                            (1, _total, key.shape[1], key.shape[2]))):
+            and _use_pallas((1, total, query.shape[1], query.shape[2]),
+                            (1, total, key.shape[1], key.shape[2]))):
         from ...ops.pallas.flash_attention import (
             flashmask_attention as _pallas_fm,
         )
-
-        block = 128
-        pad = (-q_len) % block
-        total = q_len + pad
 
         def fp(q, k, v, cu_k):
             cu = cu_k.astype(jnp.int32)

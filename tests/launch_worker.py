@@ -31,6 +31,18 @@ def main():
         if int(os.environ.get("PADDLE_RESTART_ATTEMPT", "0")) == 0:
             os._exit(17)
 
+    if "--rpc" in sys.argv:
+        from paddle_tpu.distributed import rpc
+
+        rpc.init_rpc(name=f"worker{rank}", rank=rank, world_size=world)
+        peer = (rank + 1) % world
+        out = rpc.rpc_sync(f"worker{peer}", pow, args=(rank + 2, 2))
+        assert out == (rank + 2) ** 2, out
+        infos = rpc.get_all_worker_infos()
+        assert [w.name for w in infos] == ["worker0", "worker1"], infos
+        rpc.shutdown()
+        return
+
     if "--p2p" in sys.argv:
         # cross-process eager send/recv over the control-plane store
         payload = np.arange(6, dtype="float32").reshape(2, 3) * (rank + 1)

@@ -1,0 +1,31 @@
+"""LogWriter (VisualDL role) tests."""
+import numpy as np
+
+from paddle_tpu.utils.log_writer import LogWriter, read_log, scalars
+
+
+def test_scalars_roundtrip(tmp_path):
+    with LogWriter(logdir=str(tmp_path), file_name="run.log") as w:
+        for i in range(5):
+            w.add_scalar("train/loss", 1.0 / (i + 1), step=i)
+        w.add_scalars("eval", {"acc": 0.9, "f1": 0.8}, step=4)
+    series = scalars(str(tmp_path / "run.log"), "train/loss")
+    assert [s for s, _ in series] == list(range(5))
+    np.testing.assert_allclose([v for _, v in series],
+                               [1.0, 0.5, 1 / 3, 0.25, 0.2])
+    all_series = scalars(str(tmp_path / "run.log"))
+    assert set(all_series) == {"train/loss", "eval/acc", "eval/f1"}
+
+
+def test_histogram_text_hparams(tmp_path):
+    with LogWriter(logdir=str(tmp_path), file_name="r.log") as w:
+        w.add_histogram("grads", np.random.default_rng(0).standard_normal(100),
+                        step=0, buckets=8)
+        w.add_text("note", "hello", step=0)
+        w.add_hparams({"lr": 0.1, "bs": 32}, ["loss"])
+    recs = read_log(str(tmp_path / "r.log"))
+    kinds = [r["type"] for r in recs]
+    assert kinds == ["histogram", "text", "hparams"]
+    h = recs[0]
+    assert len(h["counts"]) == 8 and sum(h["counts"]) == 100
+    assert all("wall_time" in r for r in recs)

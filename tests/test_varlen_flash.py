@@ -89,6 +89,33 @@ def test_varlen_gradients_flow_through_pallas(monkeypatch):
     assert np.all(np.isfinite(g)) and np.any(g != 0)
 
 
+def test_mismatched_qk_boundaries_fall_back(monkeypatch):
+    """cross-attention with DIFFERENT q/k segment boundaries must not take the
+    pallas route (it masks by k-documents only) — review-confirmed bug."""
+    monkeypatch.setattr(FA, "_use_pallas", lambda qs, ks: True)
+    rng = np.random.default_rng(4)
+    total = 256
+    q = rng.standard_normal((total, 4, 32)).astype("float32")
+    cu_q = np.array([0, 64, 256], "int64")
+    cu_k = np.array([0, 128, 256], "int64")
+    out, _ = F.flash_attn_unpadded(
+        paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+        paddle.to_tensor(cu_q), paddle.to_tensor(cu_k), 192, 128,
+        scale=1.0 / np.sqrt(32), causal=False)
+    assert FA.get_last_attention_backend() == "xla"
+    # the xla path intersects seg_q == seg_k; check vs direct computation
+    scale = 1.0 / np.sqrt(32)
+    seg_q = np.searchsorted(cu_q[1:-1], np.arange(total), side="right")
+    seg_k = np.searchsorted(cu_k[1:-1], np.arange(total), side="right")
+    scores = np.einsum("qhd,khd->hqk", q, q) * scale
+    mask = seg_q[:, None] == seg_k[None, :]
+    scores = np.where(mask[None], scores, -np.inf)
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    want = np.einsum("hqk,khd->qhd", p, q)
+    np.testing.assert_allclose(np.asarray(out._value), want, rtol=2e-3, atol=2e-3)
+
+
 def test_backend_marker_reports_fallback():
     rng = np.random.default_rng(3)
     q, cu, _ = _varlen_inputs(rng, [16, 16])
