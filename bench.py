@@ -193,6 +193,15 @@ def main():
         gpt, gpt_err = bench_gpt(on_accel, dev)
     except Exception as e:  # a GPT-path crash must not break the one-JSON-line contract
         gpt, gpt_err = None, {"error": repr(e)[:200]}
+    # drop GPT state (params, optimizer moments, compiled executables) before
+    # timing ResNet: leftover HBM residency measurably slows the second bench
+    import gc
+
+    gc.collect()
+    try:
+        jax.clear_caches()
+    except Exception:
+        pass
     try:
         resnet, resnet_err = bench_resnet(on_accel, dev)
     except Exception as e:  # resnet must not sink the GPT headline
