@@ -354,7 +354,10 @@ def _auto_block_q(seq: int) -> int:
     score tile within a conservative VMEM budget. Bigger blocks amortize the
     K/V VMEM loads over more MXU work — measured on v5e (GPT-350M, S=1024):
     128→42.9% MFU, 256→46.9%, 512→49.2%, 1024→50.7%."""
-    budget = 8 * 2**20  # bytes for the f32 score tile
+    # 4 MiB f32 score-tile budget: the BACKWARD dkv kernel holds two [S, BK]
+    # f32 tiles (p and dp) plus full-sequence q/do, so the fwd-only 8 MiB
+    # budget VMEM-OOMs at S=8192 (measured: 36 KB over the 16 MiB stack)
+    budget = 4 * 2**20
     for bq in (1024, 512, 256, 128):
         if seq % bq == 0 and bq * seq * 4 <= budget:
             return bq
