@@ -214,3 +214,55 @@ def test_scheduler_in_optimizer_updates_lr():
             opt.clear_grad()
             sched.step()
     np.testing.assert_allclose(seen, [0.1, 0.05, 0.025], rtol=1e-6)
+
+
+def test_adamax_matches_reference():
+    lr, b1, b2, eps = 0.02, 0.9, 0.999, 1e-8
+    hist = _run_paddle(paddle.optimizer.Adamax, learning_rate=lr, beta1=b1,
+                       beta2=b2, epsilon=eps)
+    w = W0.copy()
+    m = np.zeros_like(w)
+    u = np.zeros_like(w)
+    for t, got in enumerate(hist, 1):
+        g = _grad_of(w)
+        m = b1 * m + (1 - b1) * g
+        u = np.maximum(b2 * u, np.abs(g))
+        w = w - lr / (1 - b1**t) * m / (u + eps)
+        np.testing.assert_allclose(got, w, rtol=1e-4, atol=1e-6)
+
+
+def test_adadelta_matches_reference():
+    rho, eps, lr = 0.95, 1e-6, 1.0
+    hist = _run_paddle(paddle.optimizer.Adadelta, learning_rate=lr, rho=rho,
+                       epsilon=eps)
+    w = W0.copy()
+    acc_g = np.zeros_like(w)
+    acc_x = np.zeros_like(w)
+    for got in hist:
+        g = _grad_of(w)
+        acc_g = rho * acc_g + (1 - rho) * g * g
+        update = np.sqrt(acc_x + eps) / np.sqrt(acc_g + eps) * g
+        acc_x = rho * acc_x + (1 - rho) * update * update
+        w = w - lr * update
+        np.testing.assert_allclose(got, w, rtol=1e-4, atol=1e-6)
+
+
+def test_lamb_matches_reference():
+    lr, b1, b2, eps, wd = 0.01, 0.9, 0.999, 1e-6, 0.01
+    hist = _run_paddle(paddle.optimizer.Lamb, learning_rate=lr, beta1=b1,
+                       beta2=b2, epsilon=eps, lamb_weight_decay=wd)
+    w = W0.copy()
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    for t, got in enumerate(hist, 1):
+        g = _grad_of(w)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        mhat = m / (1 - b1**t)
+        vhat = v / (1 - b2**t)
+        r = mhat / (np.sqrt(vhat) + eps) + wd * w
+        w_norm = np.linalg.norm(w)
+        r_norm = np.linalg.norm(r)
+        trust = w_norm / r_norm if (w_norm > 0 and r_norm > 0) else 1.0
+        w = w - lr * trust * r
+        np.testing.assert_allclose(got, w, rtol=1e-3, atol=1e-5)
