@@ -71,9 +71,18 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
     def f(qv, kv, vv, sin_v, cos_v, pos):
         S = qv.shape[1]
         D = qv.shape[-1]
+        pos_applied = False
         if sin_v is None:
             inv = 1.0 / (rotary_emb_base ** (jnp.arange(0, D, 2, dtype=jnp.float32) / D))
-            pos_seq = jnp.arange(S, dtype=jnp.float32)
+            if pos is not None:
+                # absolute positions (KV-cache decode): build frequencies for
+                # exactly these positions — a table of only S rows indexed by
+                # absolute position would clip/misrotate past the first step
+                p = pos if pos.ndim == 1 else pos[0]
+                pos_seq = p.astype(jnp.float32)
+                pos_applied = True
+            else:
+                pos_seq = jnp.arange(S, dtype=jnp.float32)
             freqs = jnp.outer(pos_seq, inv)
             if use_neox_rotary_style:
                 emb = jnp.concatenate([freqs, freqs], axis=-1)
@@ -87,7 +96,7 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
                 cos_v = cos_v[None, :, None, :]
             elif sin_v.ndim == 4 and sin_v.shape[2] != 1:
                 pass
-        if pos is not None:
+        if pos is not None and not pos_applied:
             sin_v = jnp.take(sin_v[0, :, 0], pos.astype(jnp.int32), axis=0)[:, :, None, :]
             cos_v = jnp.take(cos_v[0, :, 0], pos.astype(jnp.int32), axis=0)[:, :, None, :]
         sin_v = sin_v.astype(qv.dtype)

@@ -82,7 +82,7 @@ class GPTAttention(Layer):
                                           input_is_parallel=True)
         self.dropout = c.dropout
 
-    def forward(self, x, position_ids=None):
+    def forward(self, x, position_ids=None, cache=None):
         B, S = x.shape[0], x.shape[1]
         qkv = self.qkv_proj(x)
         q_size = self.num_heads * self.head_dim
@@ -97,6 +97,50 @@ class GPTAttention(Layer):
             return q, k, vv
 
         q, k, v = apply_op(split_qkv, "split_qkv", qkv)
+        if cache is not None:
+            # autoregressive decode: rope at absolute positions, K/V appended
+            # into the preallocated cache, attention over the valid prefix
+            k_cache, v_cache, length = cache
+            if self.use_rope and position_ids is None:
+                from ..ops.creation import arange
+
+                position_ids = arange(S) + length
+            if self.use_rope:
+                from ..incubate.nn.functional import (
+                    fused_rotary_position_embedding,
+                )
+
+                q, k, _ = fused_rotary_position_embedding(
+                    q, k, position_ids=position_ids)
+
+            def attend(qv, kv, vv, kc, vc, ln):
+                ln = ln.astype(jnp.int32) if hasattr(ln, "astype") else jnp.int32(ln)
+                zero = jnp.int32(0)
+                kc = jax.lax.dynamic_update_slice(
+                    kc, kv.astype(kc.dtype), (zero, ln, zero, zero))
+                vc = jax.lax.dynamic_update_slice(
+                    vc, vv.astype(vc.dtype), (zero, ln, zero, zero))
+                max_len = kc.shape[1]
+                rep = self.num_heads // self.num_kv_heads
+                kh = jnp.repeat(kc, rep, axis=2) if rep > 1 else kc
+                vh = jnp.repeat(vc, rep, axis=2) if rep > 1 else vc
+                scale = 1.0 / math.sqrt(self.head_dim)
+                scores = jnp.einsum("bshd,bthd->bhst", qv, kh) * scale
+                pos_q = ln + jnp.arange(S)[:, None]
+                pos_k = jnp.arange(max_len)[None, :]
+                allowed = pos_k <= pos_q          # causal over the live prefix
+                scores = jnp.where(allowed[None, None],
+                                   scores, jnp.finfo(jnp.float32).min)
+                probs = jax.nn.softmax(scores.astype(jnp.float32),
+                                       axis=-1).astype(qv.dtype)
+                out = jnp.einsum("bhst,bthd->bshd", probs, vh)
+                return out, kc, vc
+
+            out, k_cache, v_cache = apply_op(attend, "decode_attention",
+                                             q, k, v, k_cache, v_cache, length,
+                                             nout=3)
+            out = out.reshape([B, S, q_size])
+            return self.out_proj(out), (k_cache, v_cache)
         if self.use_rope:
             from ..incubate.nn.functional import fused_rotary_position_embedding
 
@@ -142,7 +186,12 @@ class GPTBlock(Layer):
         self.mlp = GPTMLP(c)
         self.dropout = Dropout(c.dropout)
 
-    def forward(self, x, position_ids=None):
+    def forward(self, x, position_ids=None, cache=None):
+        if cache is not None:
+            attn_out, new_kv = self.attn(self.ln1(x), position_ids, cache=cache)
+            x = x + attn_out
+            x = x + self.mlp(self.ln2(x))
+            return x, new_kv
         x = _shard_seq(x)
         x = x + self.dropout(self.attn(self.ln1(x), position_ids))
         x = x + self.dropout(self.mlp(self.ln2(x)))
@@ -164,23 +213,33 @@ class GPTModel(Layer):
             self.lm_head = ColumnParallelLinear(c.hidden_size, c.vocab_size,
                                                 has_bias=False)
 
-    def forward(self, input_ids, position_ids=None):
+    def forward(self, input_ids, position_ids=None, caches=None, cache_offset=None):
         x = self.embed_tokens(input_ids)
         if not self.config.use_rope:
             from ..ops.creation import arange
 
             if position_ids is None:
-                position_ids = arange(input_ids.shape[1])
+                start = cache_offset if cache_offset is not None else 0
+                position_ids = arange(input_ids.shape[1]) + start
             x = x + self.embed_positions(position_ids)
-        x = _shard_seq(x)
-        for blk in self.blocks:
-            x = blk(x, position_ids)
+        if caches is not None:
+            new_caches = []
+            for blk, (kc, vc) in zip(self.blocks, caches):
+                x, new_kv = blk(x, position_ids,
+                                cache=(kc, vc, cache_offset))
+                new_caches.append(new_kv)
+        else:
+            x = _shard_seq(x)
+            for blk in self.blocks:
+                x = blk(x, position_ids)
         x = self.ln_f(x)
         if self.config.tie_embeddings:
             logits = apply_op(lambda h, w: h @ w.T, "lm_head_tied", x,
                               self.embed_tokens.weight)
         else:
             logits = self.lm_head(x)
+        if caches is not None:
+            return logits, new_caches
         return logits
 
 
@@ -202,6 +261,87 @@ class GPTForCausalLM(Layer):
             loss = per_token.mean()
             return logits, loss
         return logits
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
+                 eos_token_id=None, seed=0):
+        """Autoregressive decoding with per-layer KV caches.
+
+        Prefill runs the prompt once and fills the caches; each decode step is
+        a single-token forward over the cached prefix (no recompute). The step
+        is jitted through functional_call, so repeated calls replay one
+        compiled program. temperature==0 → greedy; otherwise softmax sampling
+        with optional top-k truncation. Returns [B, prompt+new] ids.
+        """
+        import numpy as np
+
+        from ..tensor import Tensor as _T
+
+        c = self.config
+        ids = (input_ids._value if isinstance(input_ids, Tensor)
+               else jnp.asarray(input_ids))
+        B, P = ids.shape
+        max_len = P + max_new_tokens
+        kv_h = c.num_kv_heads
+        hd = c.hidden_size // c.num_heads
+        caches = [
+            (jnp.zeros((B, max_len, kv_h, hd), jnp.float32),
+             jnp.zeros((B, max_len, kv_h, hd), jnp.float32))
+            for _ in range(c.num_layers)
+        ]
+        state = self.model_state_raw()
+
+        def step_fn(raw_state, tok_ids, caches, offset):
+            out = self.gpt.functional_call(
+                raw_state, _T(tok_ids),
+                caches=[(_T(k), _T(v)) for k, v in caches],
+                cache_offset=offset)
+            logits_t, new_caches = out
+            lg = logits_t._value if isinstance(logits_t, Tensor) else logits_t
+            nc = [
+                (kc._value if isinstance(kc, Tensor) else kc,
+                 vc._value if isinstance(vc, Tensor) else vc)
+                for kc, vc in new_caches
+            ]
+            return lg[:, -1], nc
+
+        jit_step = jax.jit(step_fn)
+
+        was_training = self.training
+        self.eval()
+        try:
+            # offset rides as a TRACED scalar: a python int would specialize the
+            # compiled step per position (one recompile per generated token)
+            last_logits, caches = jit_step(state, ids, caches, jnp.int32(0))
+            key = jax.random.key(seed)
+            out_ids = [ids]
+            finished = jnp.zeros((B,), bool)
+            for t in range(max_new_tokens):
+                if temperature and temperature > 0:
+                    lg = last_logits / jnp.float32(temperature)
+                    if top_k and top_k > 0:
+                        kth = jax.lax.top_k(lg, top_k)[0][:, -1:]
+                        lg = jnp.where(lg < kth, jnp.finfo(jnp.float32).min, lg)
+                    key, sub = jax.random.split(key)
+                    nxt = jax.random.categorical(sub, lg, axis=-1)
+                else:
+                    nxt = jnp.argmax(last_logits, axis=-1)
+                nxt = nxt.astype(ids.dtype)
+                if eos_token_id is not None:
+                    nxt = jnp.where(finished, eos_token_id, nxt)
+                    finished = finished | (nxt == eos_token_id)
+                out_ids.append(nxt[:, None])
+                if eos_token_id is not None and bool(jnp.all(finished)):
+                    break
+                last_logits, caches = jit_step(state, nxt[:, None], caches,
+                                               jnp.int32(P + t))
+            return Tensor(jnp.concatenate(out_ids, axis=1))
+        finally:
+            if was_training:
+                self.train()
+
+    def model_state_raw(self):
+        """raw state keyed as the inner GPTModel sees it (functional_call)."""
+        return self.gpt.raw_state()
 
 
 def gpt3_1p3b():
