@@ -11,6 +11,7 @@ BASELINE configs 3-5. Built TPU-first:
 """
 from __future__ import annotations
 
+import functools
 import math
 
 import jax
@@ -266,14 +267,13 @@ class GPTForCausalLM(Layer):
                  eos_token_id=None, seed=0):
         """Autoregressive decoding with per-layer KV caches.
 
-        Prefill runs the prompt once and fills the caches; each decode step is
-        a single-token forward over the cached prefix (no recompute). The step
-        is jitted through functional_call, so repeated calls replay one
-        compiled program. temperature==0 → greedy; otherwise softmax sampling
-        with optional top-k truncation. Returns [B, prompt+new] ids.
+        TPU-native shape: prefill is one compiled program; the ENTIRE decode
+        loop is a second compiled program (`lax.scan` over steps) — no
+        per-token host round-trips, which dominate wall-clock on remote/async
+        dispatch. temperature==0 → greedy; otherwise softmax sampling with
+        optional top-k truncation; eos positions freeze once hit. Returns
+        [B, prompt+new] ids.
         """
-        import numpy as np
-
         from ..tensor import Tensor as _T
 
         c = self.config
@@ -289,8 +289,10 @@ class GPTForCausalLM(Layer):
             for _ in range(c.num_layers)
         ]
         state = self.model_state_raw()
+        greedy = not (temperature and temperature > 0)
+        eos = -1 if eos_token_id is None else int(eos_token_id)
 
-        def step_fn(raw_state, tok_ids, caches, offset):
+        def model_step(raw_state, tok_ids, caches, offset):
             out = self.gpt.functional_call(
                 raw_state, _T(tok_ids),
                 caches=[(_T(k), _T(v)) for k, v in caches],
@@ -304,37 +306,50 @@ class GPTForCausalLM(Layer):
             ]
             return lg[:, -1], nc
 
-        jit_step = jax.jit(step_fn)
+        def sample(lg, key, finished):
+            if greedy:
+                nxt = jnp.argmax(lg, axis=-1)
+            else:
+                lg = lg.astype(jnp.float32) / jnp.float32(temperature)
+                if top_k and top_k > 0:
+                    kth = jax.lax.top_k(lg, top_k)[0][:, -1:]
+                    lg = jnp.where(lg < kth, jnp.finfo(jnp.float32).min, lg)
+                key, sub = jax.random.split(key)
+                nxt = jax.random.categorical(sub, lg, axis=-1)
+            nxt = nxt.astype(ids.dtype)
+            if eos >= 0:
+                nxt = jnp.where(finished, eos, nxt)
+                finished = finished | (nxt == eos)
+            return nxt, key, finished
+
+        @jax.jit
+        def run(raw_state, prompt, caches, key):
+            last_logits, caches = model_step(raw_state, prompt, caches,
+                                             jnp.int32(0))
+            finished = jnp.zeros((B,), bool)
+            tok0, key, finished = sample(last_logits, key, finished)
+
+            def body(carry, t):
+                tok, caches, key, finished = carry
+                lg, caches = model_step(raw_state, tok[:, None], caches,
+                                        (P + t).astype(jnp.int32))
+                nxt, key, finished = sample(lg, key, finished)
+                return (nxt, caches, key, finished), nxt
+
+            if max_new_tokens > 1:
+                (_, _, _, _), toks = jax.lax.scan(
+                    body, (tok0, caches, key, finished),
+                    jnp.arange(max_new_tokens - 1))
+                toks = jnp.concatenate([tok0[None], toks], axis=0)
+            else:
+                toks = tok0[None]
+            return jnp.swapaxes(toks, 0, 1)  # [B, new]
 
         was_training = self.training
         self.eval()
         try:
-            # offset rides as a TRACED scalar: a python int would specialize the
-            # compiled step per position (one recompile per generated token)
-            last_logits, caches = jit_step(state, ids, caches, jnp.int32(0))
-            key = jax.random.key(seed)
-            out_ids = [ids]
-            finished = jnp.zeros((B,), bool)
-            for t in range(max_new_tokens):
-                if temperature and temperature > 0:
-                    lg = last_logits / jnp.float32(temperature)
-                    if top_k and top_k > 0:
-                        kth = jax.lax.top_k(lg, top_k)[0][:, -1:]
-                        lg = jnp.where(lg < kth, jnp.finfo(jnp.float32).min, lg)
-                    key, sub = jax.random.split(key)
-                    nxt = jax.random.categorical(sub, lg, axis=-1)
-                else:
-                    nxt = jnp.argmax(last_logits, axis=-1)
-                nxt = nxt.astype(ids.dtype)
-                if eos_token_id is not None:
-                    nxt = jnp.where(finished, eos_token_id, nxt)
-                    finished = finished | (nxt == eos_token_id)
-                out_ids.append(nxt[:, None])
-                if eos_token_id is not None and bool(jnp.all(finished)):
-                    break
-                last_logits, caches = jit_step(state, nxt[:, None], caches,
-                                               jnp.int32(P + t))
-            return Tensor(jnp.concatenate(out_ids, axis=1))
+            new_ids = run(state, ids, caches, jax.random.key(seed))
+            return Tensor(jnp.concatenate([ids, new_ids], axis=1))
         finally:
             if was_training:
                 self.train()
