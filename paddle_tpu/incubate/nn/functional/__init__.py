@@ -77,19 +77,23 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
             if pos is not None:
                 # absolute positions (KV-cache decode): build frequencies for
                 # exactly these positions — a table of only S rows indexed by
-                # absolute position would clip/misrotate past the first step
-                p = pos if pos.ndim == 1 else pos[0]
-                pos_seq = p.astype(jnp.float32)
+                # absolute position would clip/misrotate past the first step.
+                # A [B, S] pos builds PER-ROW frequencies (left-padded batches).
+                pos_seq = pos.astype(jnp.float32)
                 pos_applied = True
             else:
                 pos_seq = jnp.arange(S, dtype=jnp.float32)
-            freqs = jnp.outer(pos_seq, inv)
+            freqs = pos_seq[..., None] * inv  # [S, D/2] or [B, S, D/2]
             if use_neox_rotary_style:
                 emb = jnp.concatenate([freqs, freqs], axis=-1)
             else:
                 emb = jnp.repeat(freqs, 2, axis=-1)
-            sin_v = jnp.sin(emb)[None, :, None, :]
-            cos_v = jnp.cos(emb)[None, :, None, :]
+            if emb.ndim == 2:       # [S, D] → [1, S, 1, D]
+                emb = emb[None, :, None, :]
+            else:                   # [B, S, D] → [B, S, 1, D]
+                emb = emb[:, :, None, :]
+            sin_v = jnp.sin(emb)
+            cos_v = jnp.cos(emb)
         else:
             if sin_v.ndim == 2:
                 sin_v = sin_v[None, :, None, :]

@@ -11,7 +11,6 @@ BASELINE configs 3-5. Built TPU-first:
 """
 from __future__ import annotations
 
-import functools
 import math
 
 import jax
@@ -281,6 +280,12 @@ class GPTForCausalLM(Layer):
                else jnp.asarray(input_ids))
         B, P = ids.shape
         max_len = P + max_new_tokens
+        if not c.use_rope and max_len > c.max_position:
+            # learned positions: JAX's OOB-gather clamping would silently
+            # reuse the last position embedding past the table
+            raise ValueError(
+                f"prompt ({P}) + max_new_tokens ({max_new_tokens}) exceeds "
+                f"max_position ({c.max_position})")
         kv_h = c.num_kv_heads
         hd = c.hidden_size // c.num_heads
         caches = [
@@ -289,6 +294,7 @@ class GPTForCausalLM(Layer):
             for _ in range(c.num_layers)
         ]
         state = self.model_state_raw()
+        ids_dtype = ids.dtype  # closure must not pin the prompt array itself
         greedy = not (temperature and temperature > 0)
         eos = -1 if eos_token_id is None else int(eos_token_id)
 
@@ -316,34 +322,48 @@ class GPTForCausalLM(Layer):
                     lg = jnp.where(lg < kth, jnp.finfo(jnp.float32).min, lg)
                 key, sub = jax.random.split(key)
                 nxt = jax.random.categorical(sub, lg, axis=-1)
-            nxt = nxt.astype(ids.dtype)
+            nxt = nxt.astype(ids_dtype)
             if eos >= 0:
                 nxt = jnp.where(finished, eos, nxt)
                 finished = finished | (nxt == eos)
             return nxt, key, finished
 
-        @jax.jit
-        def run(raw_state, prompt, caches, key):
-            last_logits, caches = model_step(raw_state, prompt, caches,
-                                             jnp.int32(0))
-            finished = jnp.zeros((B,), bool)
-            tok0, key, finished = sample(last_logits, key, finished)
+        def make_run():
+            @jax.jit
+            def run(raw_state, prompt, caches, key):
+                last_logits, caches = model_step(raw_state, prompt, caches,
+                                                 jnp.int32(0))
+                finished = jnp.zeros((B,), bool)
+                tok0, key, finished = sample(last_logits, key, finished)
 
-            def body(carry, t):
-                tok, caches, key, finished = carry
-                lg, caches = model_step(raw_state, tok[:, None], caches,
-                                        (P + t).astype(jnp.int32))
-                nxt, key, finished = sample(lg, key, finished)
-                return (nxt, caches, key, finished), nxt
+                def body(carry, t):
+                    tok, caches, key, finished = carry
+                    lg, caches = model_step(raw_state, tok[:, None], caches,
+                                            (P + t).astype(jnp.int32))
+                    nxt, key, finished = sample(lg, key, finished)
+                    return (nxt, caches, key, finished), nxt
 
-            if max_new_tokens > 1:
-                (_, _, _, _), toks = jax.lax.scan(
-                    body, (tok0, caches, key, finished),
-                    jnp.arange(max_new_tokens - 1))
-                toks = jnp.concatenate([tok0[None], toks], axis=0)
-            else:
-                toks = tok0[None]
-            return jnp.swapaxes(toks, 0, 1)  # [B, new]
+                if max_new_tokens > 1:
+                    (_, _, _, _), toks = jax.lax.scan(
+                        body, (tok0, caches, key, finished),
+                        jnp.arange(max_new_tokens - 1))
+                    toks = jnp.concatenate([tok0[None], toks], axis=0)
+                else:
+                    toks = tok0[None]
+                return jnp.swapaxes(toks, 0, 1)  # [B, new]
+
+            return run
+
+        # jit caches on function identity: rebuilding the closure per call
+        # would recompile prefill + the whole decode scan on every request
+        cache_key = (B, P, max_new_tokens, greedy, float(temperature or 0.0),
+                     int(top_k or 0), eos, str(ids.dtype))
+        run_cache = getattr(self, "_generate_cache", None)
+        if run_cache is None:
+            run_cache = self._generate_cache = {}
+        run = run_cache.get(cache_key)
+        if run is None:
+            run = run_cache[cache_key] = make_run()
 
         was_training = self.training
         self.eval()
