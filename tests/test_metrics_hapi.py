@@ -1,0 +1,101 @@
+"""Metric classes vs closed-form references + hapi evaluate/predict/callbacks."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.metric import Accuracy, Auc, Precision, Recall
+
+
+def test_accuracy_top1_and_topk():
+    m = Accuracy(topk=(1, 2))
+    pred = np.array([[0.1, 0.7, 0.2],
+                     [0.8, 0.1, 0.1],
+                     [0.2, 0.3, 0.5],
+                     [0.9, 0.05, 0.05]], "float32")
+    label = np.array([[1], [2], [2], [0]])
+    corr = m.compute(paddle.to_tensor(pred), paddle.to_tensor(label))
+    m.update(corr)
+    top1, top2 = m.accumulate()
+    # top1 correct: rows 0, 2, 3 -> 3/4; top2 additionally row 1 (0.1 tie? no:
+    # row1 top2 = {0, 1 or 2}) -> argsort desc: [0, then 1/2]; label 2 in top2
+    assert top1 == pytest.approx(3 / 4)
+    assert top2 >= top1
+    assert m.name() == ["acc_top1", "acc_top2"]
+    m.reset()
+    assert m.accumulate() == [0.0, 0.0]
+
+
+def test_precision_recall_closed_form():
+    p = Precision()
+    r = Recall()
+    preds = np.array([0.9, 0.8, 0.2, 0.7, 0.1], "float32")   # rounds to 1,1,0,1,0
+    labels = np.array([1, 0, 1, 1, 0])
+    p.update(paddle.to_tensor(preds), paddle.to_tensor(labels))
+    r.update(paddle.to_tensor(preds), paddle.to_tensor(labels))
+    # tp=2 (idx 0,3), fp=1 (idx 1), fn=1 (idx 2)
+    assert p.accumulate() == pytest.approx(2 / 3)
+    assert r.accumulate() == pytest.approx(2 / 3)
+
+
+def test_auc_matches_rank_formula():
+    rng = np.random.default_rng(0)
+    scores = rng.uniform(0, 1, 200)
+    labels = (scores + rng.normal(0, 0.3, 200) > 0.5).astype("int64")
+    if labels.sum() in (0, len(labels)):
+        labels[0] = 1 - labels[0]
+    auc = Auc()
+    auc.update(paddle.to_tensor(scores.astype("float32")),
+               paddle.to_tensor(labels))
+    got = auc.accumulate()
+    # exact AUC via the rank-sum (Mann-Whitney U) formula
+    order = np.argsort(scores)
+    ranks = np.empty(200)
+    ranks[order] = np.arange(1, 201)
+    n_pos, n_neg = labels.sum(), (1 - labels).sum()
+    want = (ranks[labels == 1].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+    assert got == pytest.approx(want, abs=0.01)
+
+
+def _fit_model():
+    X = np.random.default_rng(0).standard_normal((64, 16)).astype("float32")
+    Y = np.random.default_rng(1).integers(0, 4, (64, 1))
+
+    class DS(paddle.io.Dataset):
+        def __len__(self):
+            return 64
+
+        def __getitem__(self, i):
+            return X[i], Y[i]
+
+    with paddle.utils.unique_name.guard():
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.Adam(1e-2, parameters=net.parameters()),
+                  nn.CrossEntropyLoss(), Accuracy())
+    return model, DS()
+
+
+def test_hapi_fit_evaluate_predict():
+    model, ds = _fit_model()
+    model.fit(ds, batch_size=16, epochs=2, verbose=0)
+    res = model.evaluate(ds, batch_size=16, verbose=0)
+    assert "acc" in res or any("acc" in k for k in res), res
+    preds = model.predict(ds, batch_size=16)
+    assert len(preds) == 4  # 4 batches
+    assert tuple(preds[0].shape) == (16, 4)
+
+
+def test_hapi_early_stopping_and_checkpoint(tmp_path):
+    from paddle_tpu.hapi.callbacks import EarlyStopping, ModelCheckpoint
+
+    model, ds = _fit_model()
+    cbs = [EarlyStopping(monitor="loss", patience=1, min_delta=1e9),
+           ModelCheckpoint(save_dir=str(tmp_path))]
+    model.fit(ds, batch_size=16, epochs=5, verbose=0, callbacks=cbs)
+    # min_delta huge -> never "improves" -> stops after patience+1 epochs
+    assert model.stop_training
+    import os
+
+    assert any(f.endswith(".pdparams") for f in os.listdir(tmp_path)), os.listdir(tmp_path)
