@@ -1,0 +1,83 @@
+"""paddle.geometric: message passing + segment ops vs hand-computed graphs."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import geometric as G
+
+# graph: edges src->dst: 0->1, 1->2, 2->1, 0->0
+SRC = np.array([0, 1, 2, 0], "int64")
+DST = np.array([1, 2, 1, 0], "int64")
+X = np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]], "float32")
+
+
+def test_send_u_recv_sum_mean_max_min():
+    out = np.asarray(G.send_u_recv(paddle.to_tensor(X), paddle.to_tensor(SRC),
+                                   paddle.to_tensor(DST), "sum")._value)
+    want = np.zeros_like(X)
+    for s, d in zip(SRC, DST):
+        want[d] += X[s]
+    np.testing.assert_allclose(out, want)
+
+    out_mean = np.asarray(G.send_u_recv(paddle.to_tensor(X), paddle.to_tensor(SRC),
+                                        paddle.to_tensor(DST), "mean")._value)
+    np.testing.assert_allclose(out_mean[1], (X[0] + X[2]) / 2)
+    np.testing.assert_allclose(out_mean[2], X[1])
+
+    out_max = np.asarray(G.send_u_recv(paddle.to_tensor(X), paddle.to_tensor(SRC),
+                                       paddle.to_tensor(DST), "max")._value)
+    np.testing.assert_allclose(out_max[1], np.maximum(X[0], X[2]))
+
+
+def test_send_u_recv_out_size_and_grad():
+    t = paddle.to_tensor(X.copy(), stop_gradient=False)
+    out = G.send_u_recv(t, paddle.to_tensor(SRC), paddle.to_tensor(DST),
+                        "sum", out_size=5)
+    assert tuple(out.shape) == (5, 2)
+    out.sum().backward()
+    g = np.asarray(t.grad)
+    # node 0 sends twice, nodes 1, 2 once each
+    np.testing.assert_allclose(g, [[2, 2], [1, 1], [1, 1]])
+
+
+def test_send_ue_recv_combines_edge_features():
+    E = np.array([[0.1, 0.1], [0.2, 0.2], [0.3, 0.3], [0.4, 0.4]], "float32")
+    out = np.asarray(G.send_ue_recv(paddle.to_tensor(X), paddle.to_tensor(E),
+                                    paddle.to_tensor(SRC), paddle.to_tensor(DST),
+                                    "add", "sum")._value)
+    want = np.zeros_like(X)
+    for i, (s, d) in enumerate(zip(SRC, DST)):
+        want[d] += X[s] + E[i]
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+    out_mul = np.asarray(G.send_ue_recv(paddle.to_tensor(X), paddle.to_tensor(E),
+                                        paddle.to_tensor(SRC), paddle.to_tensor(DST),
+                                        "mul", "sum")._value)
+    want2 = np.zeros_like(X)
+    for i, (s, d) in enumerate(zip(SRC, DST)):
+        want2[d] += X[s] * E[i]
+    np.testing.assert_allclose(out_mul, want2, rtol=1e-6)
+
+
+def test_send_uv_per_edge():
+    out = np.asarray(G.send_uv(paddle.to_tensor(X), paddle.to_tensor(X),
+                               paddle.to_tensor(SRC), paddle.to_tensor(DST),
+                               "add")._value)
+    want = X[SRC] + X[DST]
+    np.testing.assert_allclose(out, want)
+
+
+def test_segment_ops():
+    data = np.array([[1.0], [2.0], [3.0], [4.0]], "float32")
+    seg = np.array([0, 0, 1, 1], "int64")
+    np.testing.assert_allclose(
+        np.asarray(G.segment_sum(paddle.to_tensor(data), paddle.to_tensor(seg))._value),
+        [[3.0], [7.0]])
+    np.testing.assert_allclose(
+        np.asarray(G.segment_mean(paddle.to_tensor(data), paddle.to_tensor(seg))._value),
+        [[1.5], [3.5]])
+    np.testing.assert_allclose(
+        np.asarray(G.segment_max(paddle.to_tensor(data), paddle.to_tensor(seg))._value),
+        [[2.0], [4.0]])
+    np.testing.assert_allclose(
+        np.asarray(G.segment_min(paddle.to_tensor(data), paddle.to_tensor(seg))._value),
+        [[1.0], [3.0]])
