@@ -2,13 +2,16 @@
 Reference: python/paddle/incubate/nn/functional/."""
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 
 from ....ops import apply_op
 
 __all__ = ["fused_linear", "fused_bias_act", "fused_rotary_position_embedding",
-           "fused_rms_norm", "fused_layer_norm", "swiglu"]
+           "fused_rms_norm", "fused_layer_norm", "swiglu", "fused_dropout_add",
+           "fused_multi_head_attention", "fused_feedforward"]
 
 
 def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
@@ -157,3 +160,135 @@ def fused_layer_norm(x, norm_weight, norm_bias=None, epsilon=1e-5, begin_norm_ax
         return out
 
     return apply_op(f, "fused_layer_norm", x, norm_weight, norm_bias, bias, residual)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """dropout(x) + y in one op (reference incubate fused_dropout_add).
+    XLA fuses the mask+scale+add into one elementwise kernel."""
+    from ....framework import random as _rng
+
+    def f(xv, yv):
+        if not training or p == 0.0:
+            return xv + yv
+        keep = jax.random.bernoulli(_rng.next_key(), 1.0 - p, xv.shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, xv / (1.0 - p), 0.0).astype(xv.dtype) + yv
+        return jnp.where(keep, xv, 0.0).astype(xv.dtype) + yv
+
+    return apply_op(f, "fused_dropout_add", x, y)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=False,
+                               pre_ln_scale=None, pre_ln_bias=None, ln_scale=None,
+                               ln_bias=None, pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, cache_kv=None, attn_mask=None,
+                               dropout_rate=0.0, attn_dropout_rate=0.0,
+                               ln_epsilon=1e-5, training=True, mode="upscale_in_train",
+                               ring_id=-1, add_residual=True, num_heads=None,
+                               transpose_qkv_wb=False, name=None):
+    """Whole MHA block in one traced op (reference incubate
+    fused_multi_head_attention): [pre-LN ->] qkv -> flash/sdpa attention ->
+    out-proj -> dropout -> [residual ->] [post-LN]. XLA fuses the epilogues;
+    the attention core reuses the framework's flash path.
+
+    qkv_weight: [3, num_heads, head_dim, embed] (paddle layout) or, with
+    transpose_qkv_wb, [embed, 3*embed]."""
+
+    def f(xv, qkv_w, qkv_b, lin_w, lin_b, pre_s, pre_b, post_s, post_b, mask):
+        B, S, E = xv.shape
+        residual = xv
+        h = xv
+        if pre_layer_norm:
+            mean = jnp.mean(h, axis=-1, keepdims=True)
+            var = jnp.var(h, axis=-1, keepdims=True)
+            h = (h - mean) * jax.lax.rsqrt(var + pre_ln_epsilon)
+            if pre_s is not None:
+                h = h * pre_s
+            if pre_b is not None:
+                h = h + pre_b
+        if transpose_qkv_wb:
+            nh = num_heads
+            hd = E // nh
+            qkv = h @ qkv_w  # [B, S, 3E]
+            if qkv_b is not None:
+                qkv = qkv + qkv_b
+            qkv = qkv.reshape(B, S, 3, nh, hd)
+        else:
+            three, nh, hd, _ = qkv_w.shape
+            qkv = jnp.einsum("bse,thde->bsthd", h, qkv_w)
+            if qkv_b is not None:
+                qkv = qkv + qkv_b[None, None]
+        q = qkv[:, :, 0]
+        k = qkv[:, :, 1]
+        v = qkv[:, :, 2]
+        scale = 1.0 / math.sqrt(q.shape[-1])
+        scores = jnp.einsum("bshd,bthd->bhst", q, k) * scale
+        if mask is not None:
+            scores = scores + mask.astype(scores.dtype)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+        ctx = jnp.einsum("bhst,bthd->bshd", probs, v).reshape(B, S, -1)
+        out = ctx @ lin_w
+        if lin_b is not None:
+            out = out + lin_b
+        if add_residual:
+            out = residual + out
+        if not pre_layer_norm:
+            mean = jnp.mean(out, axis=-1, keepdims=True)
+            var = jnp.var(out, axis=-1, keepdims=True)
+            out = (out - mean) * jax.lax.rsqrt(var + ln_epsilon)
+            if post_s is not None:
+                out = out * post_s
+            if post_b is not None:
+                out = out + post_b
+        return out
+
+    return apply_op(f, "fused_multi_head_attention", x, qkv_weight, qkv_bias,
+                    linear_weight, linear_bias, pre_ln_scale, pre_ln_bias,
+                    ln_scale, ln_bias, attn_mask)
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu", ln1_epsilon=1e-5,
+                      ln2_epsilon=1e-5, pre_layer_norm=False, training=True,
+                      mode="upscale_in_train", ring_id=-1, name=None):
+    """Transformer FFN block in one traced op (reference incubate
+    fused_feedforward): [pre-LN ->] linear1 -> act -> linear2 -> residual
+    [-> post-LN]. Dropout omitted when not training."""
+
+    def f(xv, w1, b1, w2, b2, s1, bb1, s2, bb2):
+        residual = xv
+        h = xv
+        if pre_layer_norm:
+            mean = jnp.mean(h, axis=-1, keepdims=True)
+            var = jnp.var(h, axis=-1, keepdims=True)
+            h = (h - mean) * jax.lax.rsqrt(var + ln1_epsilon)
+            if s1 is not None:
+                h = h * s1
+            if bb1 is not None:
+                h = h + bb1
+        h = h @ w1
+        if b1 is not None:
+            h = h + b1
+        act = {"relu": jax.nn.relu, "gelu": jax.nn.gelu,
+               "silu": jax.nn.silu}[activation]
+        h = act(h)
+        h = h @ w2
+        if b2 is not None:
+            h = h + b2
+        out = residual + h
+        if not pre_layer_norm:
+            mean = jnp.mean(out, axis=-1, keepdims=True)
+            var = jnp.var(out, axis=-1, keepdims=True)
+            out = (out - mean) * jax.lax.rsqrt(var + ln2_epsilon)
+            if s2 is not None:
+                out = out * s2
+            if bb2 is not None:
+                out = out + bb2
+        return out
+
+    return apply_op(f, "fused_feedforward", x, linear1_weight, linear1_bias,
+                    linear2_weight, linear2_bias, ln1_scale, ln1_bias,
+                    ln2_scale, ln2_bias)

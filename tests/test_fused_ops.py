@@ -1,0 +1,99 @@
+"""incubate fused ops: MHA/FFN blocks vs composed references, dropout_add."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate.nn.functional as IF
+
+
+def _ln(x, eps=1e-5):
+    m = x.mean(-1, keepdims=True)
+    v = x.var(-1, keepdims=True)
+    return (x - m) / np.sqrt(v + eps)
+
+
+def test_fused_mha_matches_composed_reference():
+    rng = np.random.default_rng(0)
+    B, S, E, H = 2, 6, 16, 4
+    hd = E // H
+    x = rng.standard_normal((B, S, E)).astype("float32")
+    qkv_w = (rng.standard_normal((3, H, hd, E)) * 0.1).astype("float32")
+    qkv_b = (rng.standard_normal((3, H, hd)) * 0.1).astype("float32")
+    lin_w = (rng.standard_normal((E, E)) * 0.1).astype("float32")
+    lin_b = (rng.standard_normal((E,)) * 0.1).astype("float32")
+
+    got = np.asarray(IF.fused_multi_head_attention(
+        paddle.to_tensor(x), paddle.to_tensor(qkv_w), paddle.to_tensor(lin_w),
+        pre_layer_norm=True, qkv_bias=paddle.to_tensor(qkv_b),
+        linear_bias=paddle.to_tensor(lin_b), num_heads=H)._value)
+
+    # composed numpy reference
+    h = _ln(x)
+    qkv = np.einsum("bse,thde->bsthd", h, qkv_w) + qkv_b[None, None]
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    scores = np.einsum("bshd,bthd->bhst", q, k) / np.sqrt(hd)
+    e = np.exp(scores - scores.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ctx = np.einsum("bhst,bthd->bshd", p, v).reshape(B, S, E)
+    want = x + (ctx @ lin_w + lin_b)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_mha_post_ln_and_mask():
+    rng = np.random.default_rng(1)
+    B, S, E, H = 1, 4, 8, 2
+    x = rng.standard_normal((B, S, E)).astype("float32")
+    qkv_w = (rng.standard_normal((3, H, E // H, E)) * 0.1).astype("float32")
+    lin_w = (rng.standard_normal((E, E)) * 0.1).astype("float32")
+    mask = np.full((B, H, S, S), 0.0, "float32")
+    mask[..., 2:] = -1e9  # only first two keys visible
+    out = np.asarray(IF.fused_multi_head_attention(
+        paddle.to_tensor(x), paddle.to_tensor(qkv_w), paddle.to_tensor(lin_w),
+        pre_layer_norm=False, attn_mask=paddle.to_tensor(mask),
+        num_heads=H)._value)
+    # post-LN output is normalized
+    np.testing.assert_allclose(out.mean(-1), 0.0, atol=1e-5)
+    np.testing.assert_allclose(out.var(-1), 1.0, atol=1e-3)
+
+
+def test_fused_feedforward_matches_composed():
+    rng = np.random.default_rng(2)
+    B, S, E, I = 2, 4, 8, 16
+    x = rng.standard_normal((B, S, E)).astype("float32")
+    w1 = (rng.standard_normal((E, I)) * 0.1).astype("float32")
+    w2 = (rng.standard_normal((I, E)) * 0.1).astype("float32")
+    b1 = (rng.standard_normal((I,)) * 0.1).astype("float32")
+    b2 = (rng.standard_normal((E,)) * 0.1).astype("float32")
+    got = np.asarray(IF.fused_feedforward(
+        paddle.to_tensor(x), paddle.to_tensor(w1), paddle.to_tensor(w2),
+        linear1_bias=paddle.to_tensor(b1), linear2_bias=paddle.to_tensor(b2),
+        pre_layer_norm=True, activation="relu")._value)
+    want = x + (np.maximum(_ln(x) @ w1 + b1, 0) @ w2 + b2)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_dropout_add():
+    x = paddle.to_tensor(np.ones((64, 64), "float32"))
+    y = paddle.to_tensor(np.full((64, 64), 5.0, "float32"))
+    out_eval = np.asarray(IF.fused_dropout_add(x, y, p=0.5, training=False)._value)
+    np.testing.assert_allclose(out_eval, 6.0)
+    paddle.seed(0)
+    out_train = np.asarray(IF.fused_dropout_add(x, y, p=0.5, training=True)._value)
+    kept = out_train != 5.0
+    assert 0.3 < kept.mean() < 0.7          # ~half the elements survive
+    np.testing.assert_allclose(out_train[kept], 5.0 + 2.0)  # upscaled by 1/(1-p)
+
+
+def test_fused_mha_gradient_flows():
+    rng = np.random.default_rng(3)
+    B, S, E, H = 1, 4, 8, 2
+    x = paddle.to_tensor(rng.standard_normal((B, S, E)).astype("float32"),
+                         stop_gradient=False)
+    qkv_w = paddle.to_tensor((rng.standard_normal((3, H, E // H, E)) * 0.1
+                              ).astype("float32"), stop_gradient=False)
+    lin_w = paddle.to_tensor((rng.standard_normal((E, E)) * 0.1).astype("float32"))
+    out = IF.fused_multi_head_attention(x, qkv_w, lin_w, pre_layer_norm=True,
+                                        num_heads=H)
+    out.sum().backward()
+    assert x.grad is not None and np.any(np.asarray(x.grad) != 0)
+    assert qkv_w.grad is not None and np.any(np.asarray(qkv_w.grad) != 0)
