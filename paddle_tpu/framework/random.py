@@ -33,6 +33,8 @@ class Generator:
         """Return a fresh key; advances the stream. Under a TrainStep trace a traced
         base key is folded in instead of the host key, so compiled steps get fresh
         randomness per call rather than a baked-in constant."""
+        global _consume_count
+        _consume_count += 1  # dispatch cache: randomness makes an op uncacheable
         if _trace_key is not None:
             base = _trace_key
         else:
@@ -54,6 +56,7 @@ class Generator:
 
 _default_generator = Generator(np.random.randint(0, 2**31 - 1))
 _trace_key = None
+_consume_count = 0  # bumped by every next_key(); see ops.apply_op's cache
 
 
 @contextlib.contextmanager

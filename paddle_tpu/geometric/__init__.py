@@ -54,8 +54,15 @@ def _reduce(msgs, dst, n, pool):
         raise ValueError(f"reduce op {pool!r} not supported")
     out = fn(msgs, dst, n)
     if pool in ("max", "min"):
-        # empty segments come back +-inf; the reference fills zeros
-        out = jnp.where(jnp.isfinite(out), out, 0.0)
+        # empty segments come back as the identity (+-inf for floats, dtype
+        # min/max for ints); the reference fills zeros — typed, so integer
+        # inputs keep their dtype
+        if jnp.issubdtype(out.dtype, jnp.integer):
+            info = jnp.iinfo(out.dtype)
+            sentinel = info.min if pool == "max" else info.max
+            out = jnp.where(out == sentinel, jnp.zeros((), out.dtype), out)
+        else:
+            out = jnp.where(jnp.isfinite(out), out, jnp.zeros((), out.dtype))
     return out
 
 
@@ -100,12 +107,11 @@ def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
 def _segment(name, pool):
     def fn(data, segment_ids, name=None):
         def f(d, seg):
-            n = int(jnp.max(seg)) + 1 if not isinstance(
-                seg, jax.core.Tracer) else None
-            if n is None:
-                raise ValueError(
-                    f"segment_{pool} under jit needs concrete segment_ids; "
-                    "call eagerly or use send_u_recv with out_size")
+            # int() raises ConcretizationTypeError under a tracer — which the
+            # eager-vjp cache catches (blacklists the op, falls back to the
+            # always-concrete direct path) and which tells jit users plainly
+            # that segment counts must be static
+            n = int(jnp.max(seg)) + 1
             return _reduce(d, seg.astype(jnp.int32), n, pool)
 
         return apply_op(f, name, data, segment_ids)

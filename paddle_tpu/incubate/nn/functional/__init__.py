@@ -188,12 +188,18 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=Fals
                                ring_id=-1, add_residual=True, num_heads=None,
                                transpose_qkv_wb=False, name=None):
     """Whole MHA block in one traced op (reference incubate
-    fused_multi_head_attention): [pre-LN ->] qkv -> flash/sdpa attention ->
-    out-proj -> dropout -> [residual ->] [post-LN]. XLA fuses the epilogues;
-    the attention core reuses the framework's flash path.
+    fused_multi_head_attention): [pre-LN ->] qkv -> sdpa attention (shared
+    _sdpa_core: mask + attention dropout) -> out-proj -> hidden dropout ->
+    [residual ->] [post-LN]. XLA fuses the epilogues.
 
     qkv_weight: [3, num_heads, head_dim, embed] (paddle layout) or, with
     transpose_qkv_wb, [embed, 3*embed]."""
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "cache_kv incremental decode is not supported here; use "
+            "GPTForCausalLM.generate-style per-layer caches")
+    from ....framework import random as _rng
+    from ....nn.functional.flash_attention import _sdpa_core
 
     def f(xv, qkv_w, qkv_b, lin_w, lin_b, pre_s, pre_b, post_s, post_b, mask):
         B, S, E = xv.shape
@@ -223,14 +229,19 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=Fals
         k = qkv[:, :, 1]
         v = qkv[:, :, 2]
         scale = 1.0 / math.sqrt(q.shape[-1])
-        scores = jnp.einsum("bshd,bthd->bhst", q, k) * scale
-        if mask is not None:
-            scores = scores + mask.astype(scores.dtype)
-        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
-        ctx = jnp.einsum("bhst,bthd->bshd", probs, v).reshape(B, S, -1)
+        # shared attention core: additive mask + attention dropout + training
+        ctx = _sdpa_core(q, k, v, mask, scale, False, attn_dropout_rate,
+                         training).reshape(B, S, -1)
         out = ctx @ lin_w
         if lin_b is not None:
             out = out + lin_b
+        if dropout_rate and training:
+            keep = jax.random.bernoulli(_rng.next_key(), 1.0 - dropout_rate,
+                                        out.shape)
+            if mode == "upscale_in_train":
+                out = jnp.where(keep, out / (1.0 - dropout_rate), 0.0).astype(out.dtype)
+            else:
+                out = jnp.where(keep, out, 0.0).astype(out.dtype)
         if add_residual:
             out = residual + out
         if not pre_layer_norm:
@@ -255,8 +266,17 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
                       ln2_epsilon=1e-5, pre_layer_norm=False, training=True,
                       mode="upscale_in_train", ring_id=-1, name=None):
     """Transformer FFN block in one traced op (reference incubate
-    fused_feedforward): [pre-LN ->] linear1 -> act -> linear2 -> residual
-    [-> post-LN]. Dropout omitted when not training."""
+    fused_feedforward): [pre-LN ->] linear1 -> act -> dropout1 -> linear2 ->
+    dropout2 -> residual [-> post-LN]."""
+    from ....framework import random as _rng
+
+    def _drop(h, rate):
+        if not rate or not training:
+            return h
+        keep = jax.random.bernoulli(_rng.next_key(), 1.0 - rate, h.shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, h / (1.0 - rate), 0.0).astype(h.dtype)
+        return jnp.where(keep, h, 0.0).astype(h.dtype)
 
     def f(xv, w1, b1, w2, b2, s1, bb1, s2, bb2):
         residual = xv
@@ -274,11 +294,11 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
             h = h + b1
         act = {"relu": jax.nn.relu, "gelu": jax.nn.gelu,
                "silu": jax.nn.silu}[activation]
-        h = act(h)
+        h = _drop(act(h), dropout1_rate)
         h = h @ w2
         if b2 is not None:
             h = h + b2
-        out = residual + h
+        out = residual + _drop(h, dropout2_rate)
         if not pre_layer_norm:
             mean = jnp.mean(out, axis=-1, keepdims=True)
             var = jnp.var(out, axis=-1, keepdims=True)

@@ -121,11 +121,20 @@ def apply_op(fn, name: str, *args, nout: int | None = None, **kwargs):
         return (out,), False
 
     primals = [vals[i] for i in diff_idx]
+    from ..framework import random as _rng_mod
+
+    rng_before = _rng_mod._consume_count
     out_tuple, vjp_fn, was_list = jax.vjp(closure, *primals, has_aux=True)
 
     if key is not None:
-        _EAGER_CACHE[key] = _build_entry(fn, kwargs, vals, tuple(diff_idx),
-                                         was_list)
+        if _rng_mod._consume_count != rng_before:
+            # the op drew randomness during its trace: a cached jitted program
+            # would replay the SAME folded key (identical dropout mask every
+            # step) — permanently uncacheable
+            _EAGER_CACHE[key] = _UNCACHEABLE
+        else:
+            _EAGER_CACHE[key] = _build_entry(fn, kwargs, vals, tuple(diff_idx),
+                                             was_list)
 
     _maybe_scan_nan_inf(name, out_tuple)
     outputs = [Tensor(o, stop_gradient=False) for o in out_tuple]

@@ -67,9 +67,21 @@ def test_fused_feedforward_matches_composed():
     got = np.asarray(IF.fused_feedforward(
         paddle.to_tensor(x), paddle.to_tensor(w1), paddle.to_tensor(w2),
         linear1_bias=paddle.to_tensor(b1), linear2_bias=paddle.to_tensor(b2),
-        pre_layer_norm=True, activation="relu")._value)
+        pre_layer_norm=True, activation="relu",
+        dropout1_rate=0.0, dropout2_rate=0.0)._value)
     want = x + (np.maximum(_ln(x) @ w1 + b1, 0) @ w2 + b2)
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    # dropout rates actually apply in training (they were silently ignored once)
+    paddle.seed(0)
+    with_do = np.asarray(IF.fused_feedforward(
+        paddle.to_tensor(x), paddle.to_tensor(w1), paddle.to_tensor(w2),
+        pre_layer_norm=True, activation="relu",
+        dropout1_rate=0.9, dropout2_rate=0.0, training=True)._value)
+    no_do = np.asarray(IF.fused_feedforward(
+        paddle.to_tensor(x), paddle.to_tensor(w1), paddle.to_tensor(w2),
+        pre_layer_norm=True, activation="relu",
+        dropout1_rate=0.0, dropout2_rate=0.0, training=True)._value)
+    assert not np.allclose(with_do, no_do)
 
 
 def test_fused_dropout_add():

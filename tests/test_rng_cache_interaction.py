@@ -1,0 +1,43 @@
+"""Random ops must NOT be served by the eager vjp cache (review-confirmed:
+a cached jitted program replays the identical folded RNG key, giving the
+same dropout mask on every step)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def test_eager_dropout_masks_differ_across_grad_steps():
+    x = paddle.to_tensor(np.ones((32, 32), "float32"), stop_gradient=False)
+    masks = []
+    for _ in range(3):
+        out = F.dropout(x, p=0.5, training=True)
+        out.sum().backward()
+        x.clear_gradient()
+        masks.append(np.asarray(out._value) != 0)
+    assert not np.array_equal(masks[0], masks[1]) or \
+        not np.array_equal(masks[1], masks[2]), \
+        "identical dropout masks across steps: RNG op was served from the cache"
+
+
+def test_random_op_marked_uncacheable():
+    import paddle_tpu.ops as O
+
+    O._EAGER_CACHE.clear()
+    x = paddle.to_tensor(np.ones((8, 8), "float32"), stop_gradient=False)
+    out = F.dropout(x, p=0.5, training=True)
+    out.sum().backward()
+    x.clear_gradient()
+    assert O._UNCACHEABLE in O._EAGER_CACHE.values(), \
+        "dropout's cache slot should be blacklisted, not a jitted entry"
+
+
+def test_deterministic_ops_still_cached():
+    import paddle_tpu.ops as O
+
+    O._EAGER_CACHE.clear()
+    x = paddle.to_tensor(np.ones((8, 8), "float32"), stop_gradient=False)
+    (paddle.tanh(x).sum()).backward()
+    x.clear_gradient()
+    entries = [v for v in O._EAGER_CACHE.values() if v is not O._UNCACHEABLE]
+    assert entries, "deterministic ops must still populate the cache"
