@@ -1,0 +1,65 @@
+"""vision.transforms: geometry/normalization semantics on synthetic images."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import transforms as T
+
+
+def _img(h=8, w=6, c=3, seed=0):
+    return np.random.default_rng(seed).integers(0, 256, (h, w, c)).astype("float32")
+
+
+def test_to_tensor_chw_and_scale():
+    img = _img()
+    t = T.to_tensor(img)
+    assert tuple(t.shape) == (3, 8, 6)
+    np.testing.assert_allclose(np.asarray(t._value)[0], img[..., 0] / 255.0,
+                               rtol=1e-6)
+
+
+def test_normalize():
+    img = _img()
+    mean = [10.0, 20.0, 30.0]
+    std = [2.0, 4.0, 8.0]
+    out = T.normalize(img, mean, std, data_format="HWC")
+    want = (img - np.asarray(mean)) / np.asarray(std)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5)
+
+
+def test_resize_and_center_crop():
+    img = _img(8, 6)
+    r = T.resize(img, (4, 3))
+    assert np.asarray(r).shape[:2] == (4, 3)
+    cc = T.center_crop(img, 4)
+    got = np.asarray(cc)
+    assert got.shape[:2] == (4, 4)
+    np.testing.assert_allclose(got, img[2:6, 1:5], rtol=1e-6)
+
+
+def test_flips():
+    img = _img()
+    np.testing.assert_allclose(np.asarray(T.hflip(img)), img[:, ::-1])
+    np.testing.assert_allclose(np.asarray(T.vflip(img)), img[::-1])
+    always = T.RandomHorizontalFlip(prob=1.0)
+    np.testing.assert_allclose(np.asarray(always(img)), img[:, ::-1])
+    never = T.RandomHorizontalFlip(prob=0.0)
+    np.testing.assert_allclose(np.asarray(never(img)), img)
+
+
+def test_random_crop_bounds_and_compose():
+    img = _img(16, 16)
+    rc = T.RandomCrop(8)
+    out = np.asarray(rc(img))
+    assert out.shape[:2] == (8, 8)
+    pipeline = T.Compose([T.Resize((8, 8)), T.ToTensor()])
+    t = pipeline(img)
+    assert tuple(t.shape) == (3, 8, 8)
+
+
+def test_pad():
+    img = _img(4, 4)
+    out = np.asarray(T.Pad(2)(img))
+    assert out.shape[:2] == (8, 8)
+    np.testing.assert_allclose(out[2:6, 2:6], img)
+    assert np.all(out[:2] == 0)
