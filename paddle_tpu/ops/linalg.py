@@ -162,7 +162,18 @@ def qr(x, mode="reduced", name=None):
 
 def lu(x, pivot=True, get_infos=False, name=None):
     def f(v):
-        lu_, piv = jax.scipy.linalg.lu_factor(v)
+        factor_dtype = v.dtype
+        if v.dtype == jnp.float64:
+            try:
+                on_tpu = jax.default_backend() == "tpu"
+            except Exception:
+                on_tpu = False
+            if on_tpu:
+                # TPU's LuDecomposition expander implements only F32/C64;
+                # factor in f32 and cast back (documented precision boundary)
+                factor_dtype = jnp.float32
+        lu_, piv = jax.scipy.linalg.lu_factor(v.astype(factor_dtype))
+        lu_ = lu_.astype(v.dtype)
         if get_infos:
             return lu_, piv.astype(_dt.int32) + 1, jnp.zeros((), _dt.int32)
         return lu_, piv.astype(_dt.int32) + 1

@@ -1,0 +1,99 @@
+"""Golden checks for matrix decompositions (property-based: reconstruction /
+orthogonality, since sign/permutation conventions differ across backends)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+L = paddle.linalg
+
+
+def _rand(n, m=None, seed=0):
+    return np.random.default_rng(seed).standard_normal((n, m or n)).astype("float64")
+
+
+def _spd(n, seed=0):
+    a = _rand(n, seed=seed)
+    return a @ a.T + n * np.eye(n)
+
+
+def test_svd_reconstruction_and_orthogonality():
+    a = _rand(5, 3)
+    u, s, vh = L.svd(paddle.to_tensor(a), full_matrices=False)
+    u, s, vh = (np.asarray(t._value) for t in (u, s, vh))
+    np.testing.assert_allclose(u @ np.diag(s) @ vh, a, atol=1e-10)
+    np.testing.assert_allclose(u.T @ u, np.eye(3), atol=1e-10)
+    np.testing.assert_allclose(vh @ vh.T, np.eye(3), atol=1e-10)
+    assert np.all(np.diff(s) <= 1e-12)  # descending
+
+
+def test_qr_reconstruction():
+    a = _rand(6, 4)
+    q, r = L.qr(paddle.to_tensor(a))
+    q, r = np.asarray(q._value), np.asarray(r._value)
+    np.testing.assert_allclose(q @ r, a, atol=1e-10)
+    np.testing.assert_allclose(q.T @ q, np.eye(q.shape[1]), atol=1e-10)
+    np.testing.assert_allclose(r, np.triu(r), atol=1e-12)
+
+
+def test_eigh_spectral_decomposition():
+    a = _spd(4)
+    w, v = L.eigh(paddle.to_tensor(a))
+    w, v = np.asarray(w._value), np.asarray(v._value)
+    np.testing.assert_allclose(v @ np.diag(w) @ v.T, a, atol=1e-9)
+    np.testing.assert_allclose(v.T @ v, np.eye(4), atol=1e-10)
+    assert np.all(w > 0)  # SPD
+
+
+def test_lu_reconstruction():
+    a = _rand(4)
+    out = L.lu(paddle.to_tensor(a))
+    lu = np.asarray(out[0]._value)
+    piv = np.asarray(out[1]._value).astype(int)  # 1-based sequential swaps
+    l = np.tril(lu, -1) + np.eye(4)
+    u = np.triu(lu)
+    rec = l @ u
+    ap = a.copy()
+    for i, p in enumerate(piv - 1):   # lapack ipiv: swap row i with row p
+        if p != i:
+            ap[[i, p]] = ap[[p, i]]
+    # factorization runs in f32 on TPU (LuDecomposition f64 unsupported)
+    np.testing.assert_allclose(rec, ap, atol=1e-4)
+
+
+def test_lstsq_minimizes_residual():
+    a = _rand(8, 3, seed=1)
+    b = _rand(8, 1, seed=2)
+    out = L.lstsq(paddle.to_tensor(a), paddle.to_tensor(b))
+    x = np.asarray((out[0] if isinstance(out, (tuple, list)) else out)._value)
+    want, *_ = np.linalg.lstsq(a, b, rcond=None)
+    np.testing.assert_allclose(x, want, atol=1e-8)
+
+
+def test_matrix_rank_and_cond():
+    full = _spd(4)
+    assert int(np.asarray(L.matrix_rank(paddle.to_tensor(full))._value)) == 4
+    lowrank = np.outer(np.arange(1.0, 5.0), np.arange(1.0, 5.0))
+    assert int(np.asarray(L.matrix_rank(paddle.to_tensor(lowrank))._value)) == 1
+    c = float(np.asarray(L.cond(paddle.to_tensor(full))._value))
+    assert c == pytest.approx(np.linalg.cond(full), rel=1e-6)
+
+
+def test_cov_corrcoef():
+    x = _rand(3, 50, seed=3)
+    np.testing.assert_allclose(np.asarray(L.cov(paddle.to_tensor(x))._value),
+                               np.cov(x), rtol=1e-8)
+    np.testing.assert_allclose(np.asarray(L.corrcoef(paddle.to_tensor(x))._value),
+                               np.corrcoef(x), rtol=1e-8)
+
+
+def test_triangular_and_cholesky_solve():
+    a = _spd(4, seed=5)
+    b = _rand(4, 2, seed=6)
+    lo = np.linalg.cholesky(a)
+    x = np.asarray(L.triangular_solve(paddle.to_tensor(lo), paddle.to_tensor(b),
+                                      upper=False)._value)
+    np.testing.assert_allclose(lo @ x, b, atol=1e-9)
+    xc = np.asarray(L.cholesky_solve(paddle.to_tensor(b), paddle.to_tensor(lo),
+                                     upper=False)._value)
+    np.testing.assert_allclose(a @ xc, b, atol=1e-8)
