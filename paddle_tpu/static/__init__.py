@@ -46,3 +46,7 @@ def default_main_program():  # compat no-op: jaxpr replaces Program
 
 def default_startup_program():
     return None
+
+
+from . import nn  # noqa: E402,F401
+from .nn.control_flow import Assert, Print  # noqa: E402,F401

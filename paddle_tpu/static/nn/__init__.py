@@ -1,0 +1,6 @@
+"""paddle.static.nn — traceable control flow (reference: python/paddle/static/nn/__init__.py:37)."""
+from .control_flow import (  # noqa: F401
+    Assert, Print, case, cond, switch_case, while_loop,
+)
+
+__all__ = ["case", "cond", "switch_case", "while_loop"]

@@ -1,0 +1,184 @@
+"""Traceable control flow: while_loop/cond/case/switch_case eager + under jit.
+
+Reference semantics: python/paddle/static/nn/control_flow.py (while_loop:755,
+cond:1637, case:1062, switch_case:1185)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.static import nn as static_nn
+
+
+# ------------------------------------------------------------------ eager
+def test_while_loop_eager():
+    i = paddle.to_tensor(np.array(0, "int64"))
+    s = paddle.to_tensor(np.array(0.0, "float32"))
+
+    def cond(i, s):
+        return paddle.less_than(i, paddle.to_tensor(np.array(5, "int64")))
+
+    def body(i, s):
+        return [i + 1, s + paddle.cast(i, "float32")]
+
+    i_out, s_out = static_nn.while_loop(cond, body, [i, s])
+    assert int(i_out.numpy()) == 5
+    assert float(s_out.numpy()) == 10.0
+
+
+def test_while_loop_eager_grad():
+    x = paddle.to_tensor(np.array(2.0, "float32"), stop_gradient=False)
+    i = paddle.to_tensor(np.array(0, "int64"))
+
+    def cond(i, y):
+        return paddle.less_than(i, paddle.to_tensor(np.array(3, "int64")))
+
+    def body(i, y):
+        return [i + 1, y * x]
+
+    _, y = static_nn.while_loop(cond, body, [i, paddle.ones([])])
+    y.backward()
+    # y = x^3 -> dy/dx = 3 x^2 = 12
+    np.testing.assert_allclose(np.asarray(x.grad._value), 12.0, rtol=1e-6)
+
+
+def test_cond_eager():
+    a = paddle.to_tensor(np.array(1.0, "float32"))
+    b = paddle.to_tensor(np.array(2.0, "float32"))
+    out = static_nn.cond(paddle.less_than(a, b), lambda: a + b, lambda: a - b)
+    assert float(out.numpy()) == 3.0
+    out = static_nn.cond(paddle.greater_than(a, b), lambda: a + b, lambda: a - b)
+    assert float(out.numpy()) == -1.0
+
+
+def test_case_switch_eager():
+    one = paddle.to_tensor(np.array(1.0, "float32"))
+
+    def f1():
+        return one * 1
+
+    def f2():
+        return one * 2
+
+    def f3():
+        return one * 3
+
+    t = paddle.to_tensor(np.array(True))
+    f = paddle.to_tensor(np.array(False))
+    assert float(static_nn.case([(f, f1), (t, f2)], default=f3).numpy()) == 2.0
+    assert float(static_nn.case([(f, f1), (f, f2)], default=f3).numpy()) == 3.0
+    # last fn doubles as default when default=None
+    assert float(static_nn.case([(f, f1), (f, f2)]).numpy()) == 2.0
+
+    idx = paddle.to_tensor(np.array(5, "int32"))
+    out = static_nn.switch_case(idx, {1: f1, 5: f2}, default=f3)
+    assert float(out.numpy()) == 2.0
+    out = static_nn.switch_case(paddle.to_tensor(np.array(9, "int32")),
+                                {1: f1, 5: f2}, default=f3)
+    assert float(out.numpy()) == 3.0
+
+
+# ------------------------------------------------------------------ traced
+def test_while_loop_jit():
+    @paddle.jit.to_static
+    def collatz_steps(n):
+        steps = paddle.zeros([], dtype="int64")
+
+        def cond(n, steps):
+            return n != 1
+
+        def body(n, steps):
+            n = static_nn.cond(n % 2 == 0, lambda: n // 2, lambda: 3 * n + 1)
+            return [n, steps + 1]
+
+        _, steps = static_nn.while_loop(cond, body, [n, steps])
+        return steps
+
+    out = collatz_steps(paddle.to_tensor(np.array(6, "int64")))
+    assert int(out.numpy()) == 8  # 6 3 10 5 16 8 4 2 1
+
+
+def test_cond_jit():
+    @paddle.jit.to_static
+    def f(x):
+        return static_nn.cond(paddle.sum(x) > 0,
+                              lambda: x * 2, lambda: x - 1)
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+    np.testing.assert_allclose(np.asarray(f(x)._value), [2.0, 4.0])
+    x = paddle.to_tensor(np.array([-1.0, -2.0], "float32"))
+    np.testing.assert_allclose(np.asarray(f(x)._value), [-2.0, -3.0])
+
+
+def test_switch_case_jit():
+    @paddle.jit.to_static
+    def f(idx, x):
+        return static_nn.switch_case(
+            idx, {1: lambda: x + 1, 5: lambda: x * 10},
+            default=lambda: x * 0)
+
+    x = paddle.to_tensor(np.array(3.0, "float32"))
+    assert float(f(paddle.to_tensor(np.array(1, "int32")), x).numpy()) == 4.0
+    assert float(f(paddle.to_tensor(np.array(5, "int32")), x).numpy()) == 30.0
+    assert float(f(paddle.to_tensor(np.array(7, "int32")), x).numpy()) == 0.0
+
+
+def test_case_jit():
+    @paddle.jit.to_static
+    def f(x):
+        s = paddle.sum(x)
+        return static_nn.case(
+            [(s < 0, lambda: x * 0), (s < 10, lambda: x * 2)],
+            default=lambda: x * 3)
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+    np.testing.assert_allclose(np.asarray(f(x)._value), [2.0, 4.0])
+    np.testing.assert_allclose(np.asarray(f(x * 10)._value), [30.0, 60.0])
+
+
+def test_while_loop_nested_struct_jit():
+    @paddle.jit.to_static
+    def f(x):
+        def cond(i, state):
+            return i < 3
+
+        def body(i, state):
+            return [i + 1, {"a": state["a"] + x, "b": state["b"] * 2}]
+
+        i0 = paddle.zeros([], dtype="int32")
+        _, state = static_nn.while_loop(
+            cond, body, [i0, {"a": paddle.zeros_like(x), "b": paddle.ones_like(x)}])
+        return state["a"] + state["b"]
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+    np.testing.assert_allclose(np.asarray(f(x)._value), [3 * 1 + 8, 3 * 2 + 8])
+
+
+def test_assert_and_print():
+    x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+    static_nn.Assert(paddle.to_tensor(np.array(True)))
+    with pytest.raises(ValueError):
+        static_nn.Assert(paddle.to_tensor(np.array(False)), data=[x])
+    out = paddle.static.Print(x, message="cf-test")
+    np.testing.assert_allclose(np.asarray(out._value), [1.0, 2.0])
+
+
+def test_data_dependent_model_compiles():
+    """A model with a data-dependent loop compiles under to_static (VERDICT #6 done-bar)."""
+    lin = paddle.nn.Linear(4, 4)
+
+    @paddle.jit.to_static
+    def step(x, n):
+        def cond(i, h):
+            return i < n
+
+        def body(i, h):
+            return [i + 1, paddle.tanh(lin(h))]
+
+        _, h = static_nn.while_loop(cond, body,
+                                    [paddle.zeros([], dtype="int32"), x])
+        return paddle.sum(h)
+
+    x = paddle.to_tensor(np.ones((2, 4), "float32"))
+    a = float(step(x, paddle.to_tensor(np.array(2, "int32"))).numpy())
+    b = float(step(x, paddle.to_tensor(np.array(4, "int32"))).numpy())
+    assert a != b
