@@ -15,7 +15,16 @@ from ..tensor import Tensor
 __all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
            "Exponential", "Laplace", "Gumbel", "Beta", "Gamma", "Dirichlet",
            "LogNormal", "Geometric", "Poisson", "Multinomial",
-           "kl_divergence", "register_kl"]
+           "kl_divergence", "register_kl",
+           # families.py
+           "ExponentialFamily", "Independent", "TransformedDistribution",
+           "MultivariateNormal", "StudentT", "Cauchy", "Chi2", "Binomial",
+           "ContinuousBernoulli", "LKJCholesky",
+           # transform.py
+           "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+           "ExpTransform", "IndependentTransform", "PowerTransform",
+           "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+           "StackTransform", "StickBreakingTransform", "TanhTransform"]
 
 
 def _val(x):
@@ -528,3 +537,19 @@ class Multinomial(Distribution):
             return coeff + logp
 
         return apply_op(f, "multinomial_log_prob", value)
+
+
+# extended families + transforms (import at tail: families.py imports the
+# base classes and register_kl defined above)
+from . import transform  # noqa: E402
+from .transform import (  # noqa: E402,F401
+    AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    IndependentTransform, PowerTransform, ReshapeTransform, SigmoidTransform,
+    SoftmaxTransform, StackTransform, StickBreakingTransform, TanhTransform,
+    Transform,
+)
+from .families import (  # noqa: E402,F401
+    Binomial, Cauchy, Chi2, ContinuousBernoulli, ExponentialFamily,
+    Independent, LKJCholesky, MultivariateNormal, StudentT,
+    TransformedDistribution,
+)

@@ -1,0 +1,432 @@
+"""Probability transforms. Reference: python/paddle/distribution/transform.py.
+
+Each Transform maps values through a (mostly) bijective function and exposes
+forward / inverse / forward_log_det_jacobian / inverse_log_det_jacobian plus
+shape mapping. Implemented over jnp through apply_op so tape autograd flows
+through BOTH the transformed value and the transform's own parameters
+(normalizing-flow style pathwise gradients): `_params()` returns the (possibly
+Tensor) parameters, which are passed to apply_op alongside the input.
+"""
+from __future__ import annotations
+
+import enum
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import apply_op
+from ..tensor import Tensor
+
+__all__ = [
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+]
+
+
+class Type(enum.Enum):
+    BIJECTION = "bijection"
+    INJECTION = "injection"
+    SURJECTION = "surjection"
+    OTHER = "other"
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class Transform:
+    """Base transform. Reference: transform.py (class Transform)."""
+
+    _type = Type.BIJECTION
+    # number of rightmost dims the transform acts on (0 = elementwise)
+    event_dim = 0
+
+    @property
+    def type(self):
+        return self._type
+
+    def _is_injective(self):
+        return self._type in (Type.BIJECTION, Type.INJECTION)
+
+    def _params(self):
+        return ()
+
+    def forward(self, x):
+        return apply_op(self._forward, f"{type(self).__name__}_fwd", x,
+                        *self._params())
+
+    def inverse(self, y):
+        return apply_op(self._inverse, f"{type(self).__name__}_inv", y,
+                        *self._params())
+
+    def forward_log_det_jacobian(self, x):
+        return apply_op(self._fldj, f"{type(self).__name__}_fldj", x,
+                        *self._params())
+
+    def inverse_log_det_jacobian(self, y):
+        def f(y, *params):
+            return -self._fldj(self._inverse(y, *params), *params)
+
+        return apply_op(f, f"{type(self).__name__}_ildj", y, *self._params())
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    # subclass hooks on raw jnp arrays: signature (x, *params)
+    def _forward(self, x, *params):
+        raise NotImplementedError
+
+    def _inverse(self, y, *params):
+        raise NotImplementedError
+
+    def _fldj(self, x, *params):
+        raise NotImplementedError
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AbsTransform(Transform):
+    """y = |x| (surjection onto [0, inf))."""
+
+    _type = Type.SURJECTION
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y  # positive branch, matching reference convention
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x."""
+
+    def __init__(self, loc, scale):
+        self._loc = loc
+        self._scale = scale
+
+    @property
+    def loc(self):
+        return _val(self._loc)
+
+    @property
+    def scale(self):
+        return _val(self._scale)
+
+    def _params(self):
+        return (self._loc, self._scale)
+
+    def _forward(self, x, loc, scale):
+        return loc + scale * x
+
+    def _inverse(self, y, loc, scale):
+        return (y - loc) / scale
+
+    def _fldj(self, x, loc, scale):
+        return jnp.broadcast_to(jnp.log(jnp.abs(scale)), jnp.shape(x))
+
+
+class ExpTransform(Transform):
+    """y = exp(x)."""
+
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    """y = x ** power on the positive reals."""
+
+    def __init__(self, power):
+        self._power = power
+
+    @property
+    def power(self):
+        return _val(self._power)
+
+    def _params(self):
+        return (self._power,)
+
+    def _forward(self, x, power):
+        return jnp.power(x, power)
+
+    def _inverse(self, y, power):
+        return jnp.power(y, 1.0 / power)
+
+    def _fldj(self, x, power):
+        return jnp.log(jnp.abs(power * jnp.power(x, power - 1)))
+
+
+class SigmoidTransform(Transform):
+    """y = sigmoid(x)."""
+
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    """y = tanh(x)."""
+
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _fldj(self, x):
+        # log(1 - tanh(x)^2) = 2 (log 2 - x - softplus(-2x)), numerically stable
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    """x -> softmax(x); not injective (Type.OTHER): no log-det."""
+
+    _type = Type.OTHER
+    event_dim = 1
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+
+class StickBreakingTransform(Transform):
+    """R^{K-1} -> open (K)-simplex via stick breaking. event_dim=1."""
+
+    event_dim = 1
+
+    def _offset_log(self, k):
+        # offsets K-1 ... 1 along the last axis
+        return jnp.log(jnp.arange(k, 0, -1, dtype=jnp.float32))
+
+    def _forward(self, x):
+        off = self._offset_log(x.shape[-1])
+        z = jax.nn.sigmoid(x - off)
+        z_cumprod = jnp.cumprod(1 - z, axis=-1)
+        pad_z = jnp.concatenate(
+            [z, jnp.ones(z.shape[:-1] + (1,), z.dtype)], axis=-1)
+        pad_cum = jnp.concatenate(
+            [jnp.ones(z.shape[:-1] + (1,), z.dtype), z_cumprod], axis=-1)
+        return pad_z * pad_cum
+
+    def _inverse(self, y):
+        y_crop = y[..., :-1]
+        off = self._offset_log(y_crop.shape[-1])
+        sf = 1 - jnp.cumsum(y_crop, axis=-1)
+        sf = jnp.maximum(sf, jnp.finfo(y.dtype).tiny)
+        return jnp.log(y_crop) - jnp.log(sf) + off
+
+    def _fldj(self, x):
+        off = self._offset_log(x.shape[-1])
+        xs = x - off
+        y = self._forward(x)
+        return (-xs + jax.nn.log_sigmoid(xs)
+                + jnp.log(y[..., :-1])).sum(-1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+class ReshapeTransform(Transform):
+    """Reshape trailing event dims."""
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        if int(np.prod(self.in_event_shape)) != int(np.prod(self.out_event_shape)):
+            raise ValueError("in/out event shapes must have equal sizes")
+        self.event_dim = len(self.in_event_shape)
+        self.domain_event_dim = len(self.in_event_shape)
+        self.codomain_event_dim = len(self.out_event_shape)
+
+    def _forward(self, x):
+        batch = x.shape[: x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[: y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def _fldj(self, x):
+        batch = x.shape[: x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, x.dtype)
+
+    def forward_shape(self, shape):
+        n = len(self.in_event_shape)
+        if tuple(shape[len(shape) - n:]) != self.in_event_shape:
+            raise ValueError("shape mismatch for ReshapeTransform")
+        return tuple(shape[: len(shape) - n]) + self.out_event_shape
+
+    def inverse_shape(self, shape):
+        n = len(self.out_event_shape)
+        return tuple(shape[: len(shape) - n]) + self.in_event_shape
+
+
+class IndependentTransform(Transform):
+    """Treat `reinterpreted_batch_ndims` extra dims as event dims (ldj summed)."""
+
+    def __init__(self, base, reinterpreted_batch_ndims):
+        self.base = base
+        self.reinterpreted_batch_ndims = int(reinterpreted_batch_ndims)
+        self.event_dim = base.event_dim + self.reinterpreted_batch_ndims
+        self._type = base._type
+
+    def _params(self):
+        return self.base._params()
+
+    def _forward(self, x, *params):
+        return self.base._forward(x, *params)
+
+    def _inverse(self, y, *params):
+        return self.base._inverse(y, *params)
+
+    def _fldj(self, x, *params):
+        ldj = self.base._fldj(x, *params)
+        for _ in range(self.reinterpreted_batch_ndims):
+            ldj = ldj.sum(-1)
+        return ldj
+
+    def forward_shape(self, shape):
+        return self.base.forward_shape(shape)
+
+    def inverse_shape(self, shape):
+        return self.base.inverse_shape(shape)
+
+
+def _dom(t):
+    return getattr(t, "domain_event_dim", t.event_dim)
+
+
+def _cod(t):
+    return getattr(t, "codomain_event_dim", t.event_dim)
+
+
+class ChainTransform(Transform):
+    """Composition t_n(...t_1(x)). Parameters of every link stay differentiable:
+    `_params` concatenates the links' params and the hooks re-slice them."""
+
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+        # composed domain/codomain event ranks (walks mirror torch's
+        # ComposeTransform so rank-changing links like Reshape compose right)
+        ed = 0
+        for t in reversed(self.transforms):
+            ed = max(_dom(t), _dom(t) + ed - _cod(t))
+        self.domain_event_dim = ed
+        ed = 0
+        for t in self.transforms:
+            ed = max(_cod(t), _cod(t) + ed - _dom(t))
+        self.codomain_event_dim = ed
+        self.event_dim = max(self.domain_event_dim, self.codomain_event_dim)
+        if not all(t._is_injective() for t in self.transforms):
+            self._type = Type.OTHER
+
+    def _params(self):
+        return tuple(p for t in self.transforms for p in t._params())
+
+    def _split(self, params):
+        out, i = [], 0
+        for t in self.transforms:
+            n = len(t._params())
+            out.append(params[i:i + n])
+            i += n
+        return out
+
+    def _forward(self, x, *params):
+        for t, ps in zip(self.transforms, self._split(params)):
+            x = t._forward(x, *ps)
+        return x
+
+    def _inverse(self, y, *params):
+        for t, ps in zip(reversed(self.transforms),
+                         reversed(self._split(params))):
+            y = t._inverse(y, *ps)
+        return y
+
+    def _fldj(self, x, *params):
+        # running event rank starts at the composed domain rank; each link's
+        # ldj is reduced to that rank before accumulating (torch ComposeTransform)
+        total = 0.0
+        event_dim = self.domain_event_dim
+        for t, ps in zip(self.transforms, self._split(params)):
+            ldj = t._fldj(x, *ps)
+            for _ in range(event_dim - _dom(t)):
+                ldj = ldj.sum(-1)
+            total = total + ldj
+            event_dim += _cod(t) - _dom(t)
+            x = t._forward(x, *ps)
+        return total
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return shape
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return shape
+
+
+class StackTransform(Transform):
+    """Apply transforms[i] to slice i along `axis` (slice count must match)."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+
+    def _params(self):
+        return tuple(p for t in self.transforms for p in t._params())
+
+    def _split(self, params):
+        out, i = [], 0
+        for t in self.transforms:
+            n = len(t._params())
+            out.append(params[i:i + n])
+            i += n
+        return out
+
+    def _map(self, x, method, params):
+        if x.shape[self.axis] != len(self.transforms):
+            raise ValueError(
+                f"input has {x.shape[self.axis]} slices along axis "
+                f"{self.axis} but StackTransform holds "
+                f"{len(self.transforms)} transforms")
+        slices = [
+            getattr(t, method)(xi, *ps)
+            for t, xi, ps in zip(self.transforms,
+                                 jnp.moveaxis(x, self.axis, 0),
+                                 self._split(params))
+        ]
+        return jnp.moveaxis(jnp.stack(slices, 0), 0, self.axis)
+
+    def _forward(self, x, *params):
+        return self._map(x, "_forward", params)
+
+    def _inverse(self, y, *params):
+        return self._map(y, "_inverse", params)
+
+    def _fldj(self, x, *params):
+        return self._map(x, "_fldj", params)

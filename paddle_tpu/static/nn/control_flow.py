@@ -75,6 +75,23 @@ def _check_dtypes(got, want, got_name, want_name):
                 "identical dtypes (cast explicitly)")
 
 
+def _none_fn():
+    return None
+
+
+def _probe(fn):
+    """Trace `fn` abstractly (no ops emitted) to learn its output structure."""
+    box = []
+
+    def probe():
+        arrays, td = _flatten(fn())
+        box.append(td)
+        return tuple(arrays)
+
+    specs = jax.eval_shape(probe)
+    return box[0], list(specs)
+
+
 def _debug_callbacks_supported() -> bool:
     # the axon TPU PJRT plugin rejects host send/recv callbacks; debug.print
     # inside a compiled program would crash at runtime there
@@ -142,15 +159,15 @@ def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
             return _run_branch(true_fn)
         return _run_branch(false_fn)
 
-    # traced: both branches execute under lax.cond; outputs must match.
+    # traced: each branch's ops are emitted ONLY inside its lax.cond branch
+    # (so the unselected branch never executes at runtime); the output
+    # structure/dtypes are probed up front with eval_shape, which traces
+    # abstractly without adding ops to the outer program.
     with tape.no_grad():
-        true_out = true_fn() if true_fn is not None else None
-        arrays_t, treedef = _flatten(true_out)
+        treedef, protos = _probe(true_fn if true_fn is not None else _none_fn)
 
         def t_fn(_):
-            # reuse the already-traced branch result (closed-over tracers are
-            # legal lax.cond branch outputs) instead of re-tracing true_fn
-            return arrays_t
+            return _flatten(true_fn() if true_fn is not None else None)[0]
 
         def f_fn(_):
             out_arrays, out_treedef = _flatten(
@@ -159,7 +176,7 @@ def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
                 raise ValueError(
                     "true_fn and false_fn must return the same structure: "
                     f"{treedef} vs {out_treedef}")
-            _check_dtypes(out_arrays, arrays_t, "false_fn", "true_fn")
+            _check_dtypes(out_arrays, protos, "false_fn", "true_fn")
             return out_arrays
 
         result = lax.cond(_scalar_bool(pred), t_fn, f_fn, None)
@@ -234,10 +251,7 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
 def _switch_traced(index, fns):
     """lax.switch over no-arg branch closures returning matching nests."""
     with tape.no_grad():
-        proto_arrays, treedef = _flatten(fns[0]())
-
-        def proto_branch(_):
-            return proto_arrays  # branch 0, already traced
+        treedef, protos = _probe(fns[0])
 
         def make(fn):
             def branch(_):
@@ -246,13 +260,12 @@ def _switch_traced(index, fns):
                     raise ValueError(
                         "all branches must return the same structure: "
                         f"{treedef} vs {out_treedef}")
-                _check_dtypes(out_arrays, proto_arrays, "branch", "branch 0")
+                _check_dtypes(out_arrays, protos, "branch", "branch 0")
                 return out_arrays
             return branch
 
         index = jnp.clip(jnp.asarray(index).astype(jnp.int32), 0, len(fns) - 1)
-        result = lax.switch(
-            index, [proto_branch] + [make(f) for f in fns[1:]], None)
+        result = lax.switch(index, [make(f) for f in fns], None)
     return _rebuild(result, treedef)
 
 

@@ -182,3 +182,20 @@ def test_data_dependent_model_compiles():
     a = float(step(x, paddle.to_tensor(np.array(2, "int32"))).numpy())
     b = float(step(x, paddle.to_tensor(np.array(4, "int32"))).numpy())
     assert a != b
+
+
+def test_traced_cond_branch_isolation():
+    """The unselected branch's ops must live inside the cond, not the outer
+    program (review regression: branch ran unconditionally)."""
+    import jax
+
+    def f(x):
+        return static_nn.cond(paddle.sum(x) > 0,
+                              lambda: paddle.sin(x) * 2,
+                              lambda: x)
+
+    jaxpr = jax.make_jaxpr(
+        lambda v: f(paddle.to_tensor(v))._value)(np.ones(2, "float32"))
+    outer_prims = [str(e.primitive) for e in jaxpr.jaxpr.eqns]
+    assert "cond" in outer_prims
+    assert "sin" not in outer_prims  # sin only inside the cond branch

@@ -1,0 +1,401 @@
+"""Extended distribution families + transforms, golden-checked against torch CPU.
+
+Reference semantics: python/paddle/distribution/{multivariate_normal,student_t,
+cauchy,chi2,binomial,continuous_bernoulli,independent,transformed_distribution,
+lkj_cholesky,transform}.py (which track torch.distributions closely)."""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+RTOL = 2e-5
+
+
+def t2n(x):
+    return x.detach().numpy()
+
+
+def p2n(x):
+    return np.asarray(x._value)
+
+
+# ------------------------------------------------------------------ MVN
+def test_multivariate_normal_vs_torch():
+    rng = np.random.RandomState(0)
+    loc = rng.randn(2, 3).astype("float32")
+    a = rng.randn(3, 3).astype("float32")
+    cov = (a @ a.T + 3 * np.eye(3)).astype("float32")
+    val = rng.randn(5, 2, 3).astype("float32")
+
+    mine = D.MultivariateNormal(loc, covariance_matrix=cov)
+    ref = torch.distributions.MultivariateNormal(
+        torch.tensor(loc), covariance_matrix=torch.tensor(cov))
+    np.testing.assert_allclose(
+        p2n(mine.log_prob(paddle.to_tensor(val))),
+        t2n(ref.log_prob(torch.tensor(val))), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(p2n(mine.entropy()), t2n(ref.entropy()),
+                               rtol=RTOL)
+    np.testing.assert_allclose(p2n(mine.variance), t2n(ref.variance),
+                               rtol=1e-4)
+    s = mine.sample((1000,))
+    assert list(s.shape) == [1000, 2, 3]
+    np.testing.assert_allclose(p2n(s).mean(0), loc, atol=0.4)
+
+    # precision / scale_tril constructors agree
+    prec = np.linalg.inv(cov).astype("float32")
+    m2 = D.MultivariateNormal(loc, precision_matrix=prec)
+    np.testing.assert_allclose(
+        p2n(m2.log_prob(paddle.to_tensor(val))),
+        t2n(ref.log_prob(torch.tensor(val))), rtol=1e-3, atol=1e-3)
+
+    # KL vs torch
+    loc2 = rng.randn(2, 3).astype("float32")
+    b = rng.randn(3, 3).astype("float32")
+    cov2 = (b @ b.T + 3 * np.eye(3)).astype("float32")
+    mine2 = D.MultivariateNormal(loc2, covariance_matrix=cov2)
+    ref2 = torch.distributions.MultivariateNormal(
+        torch.tensor(loc2), covariance_matrix=torch.tensor(cov2))
+    np.testing.assert_allclose(
+        p2n(D.kl_divergence(mine, mine2)),
+        t2n(torch.distributions.kl_divergence(ref, ref2)),
+        rtol=1e-4, atol=1e-4)
+
+
+# ------------------------------------------------------------------ StudentT
+def test_student_t_vs_torch():
+    df = np.array([1.5, 3.0, 10.0], "float32")
+    loc = np.array([0.0, -1.0, 2.0], "float32")
+    scale = np.array([1.0, 2.0, 0.5], "float32")
+    val = np.array([[0.3, -0.7, 1.9], [2.0, 0.0, -3.0]], "float32")
+    mine = D.StudentT(df, loc, scale)
+    ref = torch.distributions.StudentT(
+        torch.tensor(df), torch.tensor(loc), torch.tensor(scale))
+    np.testing.assert_allclose(
+        p2n(mine.log_prob(paddle.to_tensor(val))),
+        t2n(ref.log_prob(torch.tensor(val))), rtol=RTOL, atol=1e-5)
+    np.testing.assert_allclose(p2n(mine.entropy()), t2n(ref.entropy()),
+                               rtol=RTOL)
+    v = p2n(mine.variance)
+    tv = t2n(ref.variance)
+    np.testing.assert_allclose(v[1:], tv[1:], rtol=RTOL)
+    assert np.isinf(v[0]) or np.isnan(v[0])
+    assert list(mine.sample((7,)).shape) == [7, 3]
+
+
+# ------------------------------------------------------------------ Cauchy
+def test_cauchy_vs_torch():
+    loc = np.array([0.0, 1.0], "float32")
+    scale = np.array([1.0, 2.0], "float32")
+    val = np.array([[0.5, -1.0], [3.0, 1.0]], "float32")
+    mine = D.Cauchy(loc, scale)
+    ref = torch.distributions.Cauchy(torch.tensor(loc), torch.tensor(scale))
+    np.testing.assert_allclose(
+        p2n(mine.log_prob(paddle.to_tensor(val))),
+        t2n(ref.log_prob(torch.tensor(val))), rtol=RTOL)
+    np.testing.assert_allclose(
+        p2n(mine.cdf(paddle.to_tensor(val))),
+        t2n(ref.cdf(torch.tensor(val))), rtol=RTOL)
+    np.testing.assert_allclose(p2n(mine.entropy()), t2n(ref.entropy()),
+                               rtol=RTOL)
+    np.testing.assert_allclose(
+        p2n(D.kl_divergence(mine, D.Cauchy(loc + 1, scale * 2))),
+        t2n(torch.distributions.kl_divergence(
+            ref, torch.distributions.Cauchy(
+                torch.tensor(loc + 1), torch.tensor(scale * 2)))), rtol=RTOL)
+    with pytest.raises(ValueError):
+        mine.mean
+
+
+# ------------------------------------------------------------------ Chi2
+def test_chi2_vs_torch():
+    df = np.array([1.0, 4.0, 7.5], "float32")
+    val = np.array([[0.5, 2.0, 9.0]], "float32")
+    mine = D.Chi2(df)
+    ref = torch.distributions.Chi2(torch.tensor(df))
+    np.testing.assert_allclose(
+        p2n(mine.log_prob(paddle.to_tensor(val))),
+        t2n(ref.log_prob(torch.tensor(val))), rtol=1e-4)
+    np.testing.assert_allclose(p2n(mine.mean), df, rtol=RTOL)
+    np.testing.assert_allclose(p2n(mine.df), df, rtol=RTOL)
+
+
+# ------------------------------------------------------------------ Binomial
+def test_binomial_vs_torch():
+    n = np.array(10.0, "float32")
+    p = np.array([0.2, 0.5, 0.8], "float32")
+    val = np.array([[0.0, 5.0, 10.0], [3.0, 2.0, 7.0]], "float32")
+    mine = D.Binomial(n, p)
+    ref = torch.distributions.Binomial(10, torch.tensor(p))
+    np.testing.assert_allclose(
+        p2n(mine.log_prob(paddle.to_tensor(val))),
+        t2n(ref.log_prob(torch.tensor(val))), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(p2n(mine.mean), t2n(ref.mean), rtol=RTOL)
+    np.testing.assert_allclose(p2n(mine.variance), t2n(ref.variance),
+                               rtol=RTOL)
+    np.testing.assert_allclose(p2n(mine.entropy()), t2n(ref.entropy()),
+                               rtol=1e-4, atol=1e-5)
+    s = p2n(mine.sample((500,)))
+    assert s.min() >= 0 and s.max() <= 10
+    np.testing.assert_allclose(s.mean(0), 10 * p, atol=0.8)
+
+
+# ------------------------------------------------------- ContinuousBernoulli
+def test_continuous_bernoulli_vs_torch():
+    p = np.array([0.1, 0.25, 0.4999, 0.5, 0.77, 0.95], "float32")
+    val = np.array([0.0, 0.3, 0.5, 0.72, 1.0, 0.11], "float32")
+    mine = D.ContinuousBernoulli(p)
+    ref = torch.distributions.ContinuousBernoulli(torch.tensor(p))
+    np.testing.assert_allclose(
+        p2n(mine.log_prob(paddle.to_tensor(val))),
+        t2n(ref.log_prob(torch.tensor(val))), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(p2n(mine.mean), t2n(ref.mean), rtol=1e-4)
+    np.testing.assert_allclose(p2n(mine.variance), t2n(ref.variance),
+                               rtol=1e-3)
+    np.testing.assert_allclose(
+        p2n(mine.cdf(paddle.to_tensor(val))),
+        t2n(ref.cdf(torch.tensor(val))), rtol=1e-4, atol=1e-5)
+    s = p2n(mine.sample((2000,)))
+    assert s.min() >= 0 and s.max() <= 1
+    np.testing.assert_allclose(s.mean(0), t2n(ref.mean), atol=0.05)
+
+
+# ------------------------------------------------------------------ Independent
+def test_independent():
+    loc = np.zeros((4, 3), "float32")
+    scale = np.ones((4, 3), "float32")
+    base = D.Normal(loc, scale)
+    ind = D.Independent(base, 1)
+    assert ind.batch_shape == (4,)
+    assert ind.event_shape == (3,)
+    val = np.random.RandomState(0).randn(4, 3).astype("float32")
+    ref = torch.distributions.Independent(
+        torch.distributions.Normal(torch.tensor(loc), torch.tensor(scale)), 1)
+    np.testing.assert_allclose(
+        p2n(ind.log_prob(paddle.to_tensor(val))),
+        t2n(ref.log_prob(torch.tensor(val))), rtol=RTOL)
+    np.testing.assert_allclose(p2n(ind.entropy()), t2n(ref.entropy()),
+                               rtol=RTOL)
+    # KL of Independents delegates and sums
+    ind2 = D.Independent(D.Normal(loc + 1, scale), 1)
+    ref2 = torch.distributions.Independent(
+        torch.distributions.Normal(torch.tensor(loc) + 1,
+                                   torch.tensor(scale)), 1)
+    np.testing.assert_allclose(
+        p2n(D.kl_divergence(ind, ind2)),
+        t2n(torch.distributions.kl_divergence(ref, ref2)), rtol=RTOL)
+
+
+# ------------------------------------------------- TransformedDistribution
+def test_transformed_distribution_lognormal():
+    # exp(Normal) must match LogNormal exactly
+    loc = np.array([0.0, 0.5], "float32")
+    scale = np.array([1.0, 0.7], "float32")
+    td = D.TransformedDistribution(D.Normal(loc, scale), [D.ExpTransform()])
+    ln = D.LogNormal(loc, scale)
+    val = np.array([[0.5, 1.5], [2.0, 0.3]], "float32")
+    np.testing.assert_allclose(
+        p2n(td.log_prob(paddle.to_tensor(val))),
+        p2n(ln.log_prob(paddle.to_tensor(val))), rtol=RTOL)
+    s = p2n(td.sample((10,)))
+    assert (s > 0).all()
+
+
+def test_transformed_distribution_affine_chain():
+    base = D.Normal(np.float32(0.0), np.float32(1.0))
+    td = D.TransformedDistribution(
+        base, [D.AffineTransform(np.float32(2.0), np.float32(3.0))])
+    ref = torch.distributions.TransformedDistribution(
+        torch.distributions.Normal(0.0, 1.0),
+        [torch.distributions.AffineTransform(2.0, 3.0)])
+    val = np.array([1.0, 2.0, 5.0], "float32")
+    np.testing.assert_allclose(
+        p2n(td.log_prob(paddle.to_tensor(val))),
+        t2n(ref.log_prob(torch.tensor(val))), rtol=RTOL)
+
+
+# ------------------------------------------------------------------ transforms
+@pytest.mark.parametrize("pt, tt", [
+    (lambda: D.ExpTransform(), lambda: torch.distributions.ExpTransform()),
+    (lambda: D.SigmoidTransform(),
+     lambda: torch.distributions.SigmoidTransform()),
+    (lambda: D.TanhTransform(), lambda: torch.distributions.TanhTransform()),
+    (lambda: D.AffineTransform(np.float32(1.5), np.float32(-2.0)),
+     lambda: torch.distributions.AffineTransform(1.5, -2.0)),
+    (lambda: D.PowerTransform(np.float32(2.0)),
+     lambda: torch.distributions.PowerTransform(2.0)),
+])
+def test_scalar_transforms_vs_torch(pt, tt):
+    x = np.array([0.1, 0.5, 1.7, -0.3], "float32")
+    mine, ref = pt(), tt()
+    if isinstance(mine, D.PowerTransform):
+        x = np.abs(x)  # domain is the positive reals
+    y = p2n(mine.forward(paddle.to_tensor(x)))
+    ty = t2n(ref(torch.tensor(x)))
+    np.testing.assert_allclose(y, ty, rtol=RTOL, equal_nan=True)
+    mask = ~np.isnan(ty)
+    np.testing.assert_allclose(
+        p2n(mine.inverse(paddle.to_tensor(ty)))[mask], x[mask],
+        rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        p2n(mine.forward_log_det_jacobian(paddle.to_tensor(x))),
+        t2n(ref.log_abs_det_jacobian(torch.tensor(x), torch.tensor(ty))),
+        rtol=RTOL, equal_nan=True)
+
+
+def test_stickbreaking_transform_vs_torch():
+    x = np.array([[0.3, -0.7, 1.2], [0.0, 2.0, -1.0]], "float32")
+    mine = D.StickBreakingTransform()
+    ref = torch.distributions.StickBreakingTransform()
+    y = p2n(mine.forward(paddle.to_tensor(x)))
+    ty = t2n(ref(torch.tensor(x)))
+    np.testing.assert_allclose(y, ty, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(y.sum(-1), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(
+        p2n(mine.inverse(paddle.to_tensor(y))), x, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(
+        p2n(mine.forward_log_det_jacobian(paddle.to_tensor(x))),
+        t2n(ref.log_abs_det_jacobian(torch.tensor(x), torch.tensor(ty))),
+        rtol=1e-4, atol=1e-5)
+    assert mine.forward_shape((2, 3)) == (2, 4)
+    assert mine.inverse_shape((2, 4)) == (2, 3)
+
+
+def test_chain_reshape_independent_stack_transforms():
+    chain = D.ChainTransform(
+        [D.AffineTransform(np.float32(0.0), np.float32(2.0)),
+         D.ExpTransform()])
+    x = np.array([0.5, 1.0], "float32")
+    np.testing.assert_allclose(p2n(chain.forward(paddle.to_tensor(x))),
+                               np.exp(2 * x), rtol=RTOL)
+    np.testing.assert_allclose(
+        p2n(chain.inverse(paddle.to_tensor(np.exp(2 * x)))), x, rtol=RTOL)
+    # chain fldj = log2 + 2x (affine then exp)
+    np.testing.assert_allclose(
+        p2n(chain.forward_log_det_jacobian(paddle.to_tensor(x))),
+        np.log(2.0) + 2 * x, rtol=RTOL)
+
+    rt = D.ReshapeTransform((2, 3), (6,))
+    xr = np.arange(6, dtype="float32").reshape(1, 2, 3)
+    assert p2n(rt.forward(paddle.to_tensor(xr))).shape == (1, 6)
+    assert p2n(rt.inverse(paddle.to_tensor(xr.reshape(1, 6)))).shape == (1, 2, 3)
+    assert rt.forward_shape((5, 2, 3)) == (5, 6)
+
+    it = D.IndependentTransform(D.ExpTransform(), 1)
+    xi = np.ones((4, 3), "float32")
+    assert p2n(it.forward_log_det_jacobian(paddle.to_tensor(xi))).shape == (4,)
+
+    st = D.StackTransform([D.ExpTransform(), D.AffineTransform(
+        np.float32(0.0), np.float32(2.0))], axis=1)
+    xs = np.ones((3, 2), "float32")
+    out = p2n(st.forward(paddle.to_tensor(xs)))
+    np.testing.assert_allclose(out[:, 0], np.e, rtol=RTOL)
+    np.testing.assert_allclose(out[:, 1], 2.0, rtol=RTOL)
+
+
+# ------------------------------------------------------------------ LKJ
+def test_lkj_cholesky_vs_torch():
+    torch.manual_seed(0)
+    ref = torch.distributions.LKJCholesky(3, concentration=1.5)
+    sample = ref.sample((4,))
+    mine = D.LKJCholesky(3, concentration=np.float32(1.5))
+    np.testing.assert_allclose(
+        p2n(mine.log_prob(paddle.to_tensor(sample.numpy()))),
+        t2n(ref.log_prob(sample)), rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("method", ["onion", "cvine"])
+def test_lkj_cholesky_sample_valid(method):
+    mine = D.LKJCholesky(4, concentration=np.float32(2.0),
+                         sample_method=method)
+    s = p2n(mine.sample((64,)))
+    assert s.shape == (64, 4, 4)
+    # lower triangular with positive diagonal
+    assert np.allclose(np.triu(s, 1), 0.0, atol=1e-6)
+    assert (np.diagonal(s, axis1=-2, axis2=-1) > 0).all()
+    # rows are unit-norm -> L L^T is a correlation matrix
+    corr = s @ np.swapaxes(s, -1, -2)
+    np.testing.assert_allclose(
+        np.diagonal(corr, axis1=-2, axis2=-1), 1.0, atol=1e-5)
+    assert (np.abs(corr) <= 1 + 1e-5).all()
+    # log_prob finite on its own samples
+    lp = p2n(mine.log_prob(paddle.to_tensor(s)))
+    assert np.isfinite(lp).all()
+
+
+# ------------------------------------------------------------------ rsample grads
+def test_transformed_rsample_gradient():
+    loc = paddle.to_tensor(np.array(0.5, "float32"), stop_gradient=False)
+    t = D.AffineTransform(loc, np.float32(2.0))
+    x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+    y = t.forward(x)
+    s = paddle.sum(y)
+    s.backward()
+    np.testing.assert_allclose(np.asarray(loc.grad._value), 2.0, rtol=RTOL)
+
+
+def test_namespace_export_parity():
+    ref_all = {
+        'Bernoulli', 'Beta', 'Categorical', 'Cauchy', 'Chi2',
+        'ContinuousBernoulli', 'Dirichlet', 'Distribution', 'Exponential',
+        'ExponentialFamily', 'Multinomial', 'MultivariateNormal', 'Normal',
+        'Uniform', 'kl_divergence', 'register_kl', 'Independent',
+        'TransformedDistribution', 'Laplace', 'LogNormal', 'LKJCholesky',
+        'Gamma', 'Gumbel', 'Geometric', 'Binomial', 'Poisson', 'StudentT',
+        'Transform', 'AbsTransform', 'AffineTransform', 'ChainTransform',
+        'ExpTransform', 'IndependentTransform', 'PowerTransform',
+        'ReshapeTransform', 'SigmoidTransform', 'SoftmaxTransform',
+        'StackTransform', 'StickBreakingTransform', 'TanhTransform',
+    }
+    missing = ref_all - set(D.__all__)
+    assert not missing, f"missing exports: {missing}"
+
+
+# ------------------------------------------------------- review regressions
+def test_transformed_reshape_event_rank():
+    td = D.TransformedDistribution(
+        D.Normal(np.zeros(6, "float32"), np.ones(6, "float32")),
+        [D.ReshapeTransform((6,), (2, 3))])
+    assert td.batch_shape == ()
+    assert td.event_shape == (2, 3)
+    val = np.random.RandomState(0).randn(2, 3).astype("float32")
+    lp = p2n(td.log_prob(paddle.to_tensor(val)))
+    assert lp.shape == ()
+    ref = torch.distributions.TransformedDistribution(
+        torch.distributions.Independent(
+            torch.distributions.Normal(torch.zeros(6), torch.ones(6)), 1),
+        [torch.distributions.ReshapeTransform((6,), (2, 3))])
+    np.testing.assert_allclose(lp, t2n(ref.log_prob(torch.tensor(val))),
+                               rtol=RTOL)
+
+
+def test_chain_with_reshape_fldj():
+    chain = D.ChainTransform(
+        [D.ReshapeTransform((4,), (2, 2)), D.ExpTransform()])
+    assert chain.domain_event_dim == 1
+    assert chain.codomain_event_dim == 2
+    x = np.ones((3, 4), "float32")
+    ldj = p2n(chain.forward_log_det_jacobian(paddle.to_tensor(x)))
+    assert ldj.shape == (3,)
+    np.testing.assert_allclose(ldj, 4.0, rtol=RTOL)  # sum of x over event
+
+
+def test_stack_transform_validation_and_grads():
+    st = D.StackTransform([D.ExpTransform(), D.ExpTransform()], axis=0)
+    with pytest.raises(ValueError):
+        st.forward(paddle.to_tensor(np.ones((3, 2), "float32")))
+    loc = paddle.to_tensor(np.array(1.0, "float32"), stop_gradient=False)
+    st2 = D.StackTransform(
+        [D.AffineTransform(loc, np.float32(2.0)), D.ExpTransform()], axis=0)
+    y = st2.forward(paddle.to_tensor(np.ones((2, 3), "float32")))
+    paddle.sum(y).backward()
+    np.testing.assert_allclose(np.asarray(loc.grad._value), 3.0, rtol=RTOL)
+
+
+def test_independent_negative_rank_raises():
+    base = D.Normal(np.zeros((2, 3), "float32"), np.ones((2, 3), "float32"))
+    with pytest.raises(ValueError):
+        D.Independent(base, -1)
+    with pytest.raises(ValueError):
+        D.Independent(base, 3)
