@@ -17,6 +17,7 @@ from ..framework import random as _rng
 from ..ops import apply_op
 from ..tensor import Tensor
 from . import Beta, Distribution, Gamma, register_kl
+from .transform import _cod, _dom
 
 
 def _val(x):
@@ -91,9 +92,7 @@ class TransformedDistribution(Distribution):
         # rank (rank-changing links like Reshape compose correctly)
         ev = len(base.event_shape)
         for t in self.transforms:
-            dom = getattr(t, "domain_event_dim", t.event_dim)
-            cod = getattr(t, "codomain_event_dim", t.event_dim)
-            ev = max(ev, dom) - dom + cod
+            ev = max(ev, _dom(t)) - _dom(t) + _cod(t)
         shape = tuple(base.batch_shape) + tuple(base.event_shape)
         for t in self.transforms:
             shape = t.forward_shape(shape)
@@ -120,9 +119,8 @@ class TransformedDistribution(Distribution):
         y = value
         for t in reversed(self.transforms):
             x = t.inverse(y)
-            dom = getattr(t, "domain_event_dim", t.event_dim)
-            cod = getattr(t, "codomain_event_dim", t.event_dim)
-            event_dim += dom - cod
+            dom = _dom(t)
+            event_dim += dom - _cod(t)
             ldj = t.forward_log_det_jacobian(x)
             red = apply_op(
                 lambda v, n=event_dim - dom: _sum_rightmost(v, n),

@@ -323,9 +323,26 @@ def _cod(t):
     return getattr(t, "codomain_event_dim", t.event_dim)
 
 
-class ChainTransform(Transform):
-    """Composition t_n(...t_1(x)). Parameters of every link stay differentiable:
-    `_params` concatenates the links' params and the hooks re-slice them."""
+class _MultiTransform(Transform):
+    """Shared param-concatenate/re-slice protocol for Chain/Stack: `_params`
+    concatenates every link's params; `_split` re-slices them per link."""
+
+    transforms: list
+
+    def _params(self):
+        return tuple(p for t in self.transforms for p in t._params())
+
+    def _split(self, params):
+        out, i = [], 0
+        for t in self.transforms:
+            n = len(t._params())
+            out.append(params[i:i + n])
+            i += n
+        return out
+
+
+class ChainTransform(_MultiTransform):
+    """Composition t_n(...t_1(x)). Parameters of every link stay differentiable."""
 
     def __init__(self, transforms):
         self.transforms = list(transforms)
@@ -342,17 +359,6 @@ class ChainTransform(Transform):
         self.event_dim = max(self.domain_event_dim, self.codomain_event_dim)
         if not all(t._is_injective() for t in self.transforms):
             self._type = Type.OTHER
-
-    def _params(self):
-        return tuple(p for t in self.transforms for p in t._params())
-
-    def _split(self, params):
-        out, i = [], 0
-        for t in self.transforms:
-            n = len(t._params())
-            out.append(params[i:i + n])
-            i += n
-        return out
 
     def _forward(self, x, *params):
         for t, ps in zip(self.transforms, self._split(params)):
@@ -390,23 +396,12 @@ class ChainTransform(Transform):
         return shape
 
 
-class StackTransform(Transform):
+class StackTransform(_MultiTransform):
     """Apply transforms[i] to slice i along `axis` (slice count must match)."""
 
     def __init__(self, transforms, axis=0):
         self.transforms = list(transforms)
         self.axis = int(axis)
-
-    def _params(self):
-        return tuple(p for t in self.transforms for p in t._params())
-
-    def _split(self, params):
-        out, i = [], 0
-        for t in self.transforms:
-            n = len(t._params())
-            out.append(params[i:i + n])
-            i += n
-        return out
 
     def _map(self, x, method, params):
         if x.shape[self.axis] != len(self.transforms):

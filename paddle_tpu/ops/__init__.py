@@ -174,6 +174,11 @@ def _cell_key(v, depth=0):
 
 
 def _eager_key(fn, name, vals, diff_idx, kwargs):
+    if getattr(fn, "__self__", None) is not None:
+        # bound method: behavior depends on instance state that the key cannot
+        # see (e.g. a Transform's sub-transform list) — two instances of the
+        # same class would collide on one cache entry. Never cache.
+        return None
     code = getattr(fn, "__code__", None)
     if code is None:
         # builtin / PjitFunction: key on the object itself (the cache entry
@@ -187,6 +192,12 @@ def _eager_key(fn, name, vals, diff_idx, kwargs):
         cells = tuple(_cell_key(c.cell_contents) for c in (fn.__closure__ or ()))
         if _builtins.any(c is None for c in cells):
             return None
+        if fn.__defaults__:
+            # default args parameterize behavior (e.g. lambda v, n=2: ...)
+            dflt = tuple(_cell_key(d) for d in fn.__defaults__)
+            if _builtins.any(d is None for d in dflt):
+                return None
+            cells = cells + (("__defaults__",) + dflt,)
     sig = []
     for v in vals:
         if isinstance(v, (jax.Array, np.ndarray, np.generic)):

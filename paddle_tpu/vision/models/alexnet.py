@@ -1,0 +1,49 @@
+"""AlexNet. Reference: python/paddle/vision/models/alexnet.py (API-identical)."""
+from __future__ import annotations
+
+from ...nn import (
+    Conv2D, Dropout, Flatten, Layer, Linear, MaxPool2D, ReLU, Sequential,
+)
+
+__all__ = ["AlexNet", "alexnet"]
+
+
+class AlexNet(Layer):
+    """Reference: alexnet.py:86 (conv stack with 3x3 maxpools + dropout head)."""
+
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        self.num_classes = num_classes
+        self.features = Sequential(
+            Conv2D(3, 64, 11, stride=4, padding=2), ReLU(),
+            MaxPool2D(3, stride=2),
+            Conv2D(64, 192, 5, padding=2), ReLU(),
+            MaxPool2D(3, stride=2),
+            Conv2D(192, 384, 3, padding=1), ReLU(),
+            Conv2D(384, 256, 3, padding=1), ReLU(),
+            Conv2D(256, 256, 3, padding=1), ReLU(),
+            MaxPool2D(3, stride=2),
+        )
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Flatten(),
+                Dropout(0.5),
+                Linear(256 * 6 * 6, 4096), ReLU(),
+                Dropout(0.5),
+                Linear(4096, 4096), ReLU(),
+                Linear(4096, num_classes),
+            )
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.num_classes > 0:
+            x = self.classifier(x)
+        return x
+
+
+def alexnet(pretrained=False, **kwargs):
+    model = AlexNet(**kwargs)
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights are not bundled; load a converted state_dict")
+    return model

@@ -1,0 +1,138 @@
+"""DenseNet family. Reference: python/paddle/vision/models/densenet.py
+(API-identical: DenseNet(layers, bn_size, dropout, num_classes, with_pool),
+densenet121/161/169/201/264). Pre-activation BN-ReLU-Conv dense layers with
+channel concatenation."""
+from __future__ import annotations
+
+from ...nn import (
+    AdaptiveAvgPool2D, AvgPool2D, BatchNorm2D, Conv2D, Dropout, Layer, Linear,
+    MaxPool2D, ReLU, Sequential,
+)
+from ...ops.manipulation import concat, flatten
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "densenet264"]
+
+_CFG = {
+    121: ((6, 12, 24, 16), 32, 64),
+    161: ((6, 12, 36, 24), 48, 96),
+    169: ((6, 12, 32, 32), 32, 64),
+    201: ((6, 12, 48, 32), 32, 64),
+    264: ((6, 12, 64, 48), 32, 64),
+}
+
+
+class _DenseLayer(Layer):
+    """BN-ReLU-Conv1x1 (bottleneck) -> BN-ReLU-Conv3x3 (growth). Ref:
+    densenet.py:116."""
+
+    def __init__(self, num_channels, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.bn1 = BatchNorm2D(num_channels)
+        self.relu = ReLU()
+        self.conv1 = Conv2D(num_channels, bn_size * growth_rate, 1,
+                            bias_attr=False)
+        self.bn2 = BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = Conv2D(bn_size * growth_rate, growth_rate, 3, padding=1,
+                            bias_attr=False)
+        self.dropout = Dropout(dropout) if dropout else None
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.bn1(x)))
+        out = self.conv2(self.relu(self.bn2(out)))
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return concat([x, out], axis=1)
+
+
+class _Transition(Layer):
+    """BN-ReLU-Conv1x1 (halve channels) + 2x2 avgpool. Ref: densenet.py:191."""
+
+    def __init__(self, num_channels, num_output_features):
+        super().__init__()
+        self.bn = BatchNorm2D(num_channels)
+        self.relu = ReLU()
+        self.conv = Conv2D(num_channels, num_output_features, 1,
+                           bias_attr=False)
+        self.pool = AvgPool2D(2, stride=2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.bn(x))))
+
+
+class DenseNet(Layer):
+    """Reference: densenet.py:242."""
+
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        if layers not in _CFG:
+            raise ValueError(f"layers must be one of {sorted(_CFG)}")
+        block_config, growth_rate, num_init_features = _CFG[layers]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        self.stem = Sequential(
+            Conv2D(3, num_init_features, 7, stride=2, padding=3,
+                   bias_attr=False),
+            BatchNorm2D(num_init_features),
+            ReLU(),
+            MaxPool2D(3, stride=2, padding=1),
+        )
+        blocks = []
+        num_channels = num_init_features
+        for i, num_layers in enumerate(block_config):
+            for j in range(num_layers):
+                blocks.append(_DenseLayer(
+                    num_channels + j * growth_rate, growth_rate, bn_size,
+                    dropout))
+            num_channels += num_layers * growth_rate
+            if i != len(block_config) - 1:
+                blocks.append(_Transition(num_channels, num_channels // 2))
+                num_channels //= 2
+        self.blocks = Sequential(*blocks)
+        self.bn_final = BatchNorm2D(num_channels)
+        self.relu_final = ReLU()
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = Linear(num_channels, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.blocks(x)
+        x = self.relu_final(self.bn_final(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = flatten(x, 1)
+            x = self.classifier(x)
+        return x
+
+
+def _densenet(layers, pretrained, **kwargs):
+    model = DenseNet(layers=layers, **kwargs)
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights are not bundled; load a converted state_dict")
+    return model
+
+
+def densenet121(pretrained=False, **kwargs):
+    return _densenet(121, pretrained, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return _densenet(161, pretrained, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return _densenet(169, pretrained, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return _densenet(201, pretrained, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return _densenet(264, pretrained, **kwargs)

@@ -1,0 +1,115 @@
+"""GoogLeNet (Inception v1). Reference: python/paddle/vision/models/googlenet.py
+(API-identical: GoogLeNet(num_classes, with_pool); forward returns
+(out, aux1, aux2) like the reference's googlenet.py:256)."""
+from __future__ import annotations
+
+from ...nn import (
+    AdaptiveAvgPool2D, AvgPool2D, Conv2D, Dropout, Layer, Linear, MaxPool2D,
+    ReLU, Sequential,
+)
+from ...ops.manipulation import concat, flatten
+
+__all__ = ["GoogLeNet", "googlenet"]
+
+
+class _ConvReLU(Sequential):
+    def __init__(self, in_c, out_c, kernel, stride=1, padding=0):
+        super().__init__(
+            Conv2D(in_c, out_c, kernel, stride=stride, padding=padding),
+            ReLU(),
+        )
+
+
+class Inception(Layer):
+    """Four parallel branches concatenated on channels. Ref: googlenet.py:90."""
+
+    def __init__(self, in_c, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.branch1 = _ConvReLU(in_c, c1, 1)
+        self.branch2 = Sequential(_ConvReLU(in_c, c3r, 1),
+                                  _ConvReLU(c3r, c3, 3, padding=1))
+        self.branch3 = Sequential(_ConvReLU(in_c, c5r, 1),
+                                  _ConvReLU(c5r, c5, 5, padding=2))
+        self.branch4 = Sequential(MaxPool2D(3, stride=1, padding=1),
+                                  _ConvReLU(in_c, proj, 1))
+
+    def forward(self, x):
+        return concat([self.branch1(x), self.branch2(x), self.branch3(x),
+                       self.branch4(x)], axis=1)
+
+
+class _AuxHead(Layer):
+    def __init__(self, in_c, num_classes):
+        super().__init__()
+        self.pool = AvgPool2D(5, stride=3)
+        self.conv = _ConvReLU(in_c, 128, 1)
+        self.fc1 = Linear(128 * 4 * 4, 1024)
+        self.relu = ReLU()
+        self.drop = Dropout(0.7)
+        self.fc2 = Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.conv(self.pool(x))
+        x = flatten(x, 1)
+        x = self.drop(self.relu(self.fc1(x)))
+        return self.fc2(x)
+
+
+class GoogLeNet(Layer):
+    """Reference: googlenet.py:130."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        self.stem = Sequential(
+            _ConvReLU(3, 64, 7, stride=2, padding=3),
+            MaxPool2D(3, stride=2, ceil_mode=True),
+            _ConvReLU(64, 64, 1),
+            _ConvReLU(64, 192, 3, padding=1),
+            MaxPool2D(3, stride=2, ceil_mode=True),
+        )
+        self.inc3a = Inception(192, 64, 96, 128, 16, 32, 32)
+        self.inc3b = Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = MaxPool2D(3, stride=2, ceil_mode=True)
+        self.inc4a = Inception(480, 192, 96, 208, 16, 48, 64)
+        self.inc4b = Inception(512, 160, 112, 224, 24, 64, 64)
+        self.inc4c = Inception(512, 128, 128, 256, 24, 64, 64)
+        self.inc4d = Inception(512, 112, 144, 288, 32, 64, 64)
+        self.inc4e = Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = MaxPool2D(3, stride=2, ceil_mode=True)
+        self.inc5a = Inception(832, 256, 160, 320, 32, 128, 128)
+        self.inc5b = Inception(832, 384, 192, 384, 48, 128, 128)
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.drop = Dropout(0.4)
+            self.fc = Linear(1024, num_classes)
+            self.aux1 = _AuxHead(512, num_classes)
+            self.aux2 = _AuxHead(528, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.inc3b(self.inc3a(x)))
+        x = self.inc4a(x)
+        aux1 = self.aux1(x) if self.num_classes > 0 else None
+        x = self.inc4d(self.inc4c(self.inc4b(x)))
+        aux2 = self.aux2(x) if self.num_classes > 0 else None
+        x = self.pool4(self.inc4e(x))
+        x = self.inc5b(self.inc5a(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = flatten(x, 1)
+            x = self.fc(self.drop(x))
+            return x, aux1, aux2
+        return x
+
+
+def googlenet(pretrained=False, **kwargs):
+    model = GoogLeNet(**kwargs)
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights are not bundled; load a converted state_dict")
+    return model

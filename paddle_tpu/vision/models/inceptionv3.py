@@ -1,0 +1,185 @@
+"""InceptionV3. Reference: python/paddle/vision/models/inceptionv3.py
+(API-identical: InceptionV3(num_classes, with_pool), inception_v3). 299x299
+input; factorized 7x1/1x7 and 3x1/1x3 convolutions (asymmetric-kernel ops the
+ResNet path never exercises)."""
+from __future__ import annotations
+
+from ...nn import (
+    AdaptiveAvgPool2D, AvgPool2D, BatchNorm2D, Conv2D, Dropout, Layer, Linear,
+    MaxPool2D, ReLU, Sequential,
+)
+from ...ops.manipulation import concat, flatten
+
+__all__ = ["InceptionV3", "inception_v3"]
+
+
+class _ConvBN(Sequential):
+    def __init__(self, in_c, out_c, kernel, stride=1, padding=0):
+        super().__init__(
+            Conv2D(in_c, out_c, kernel, stride=stride, padding=padding,
+                   bias_attr=False),
+            BatchNorm2D(out_c),
+            ReLU(),
+        )
+
+
+class InceptionStem(Layer):
+    """Reference: inceptionv3.py:55."""
+
+    def __init__(self):
+        super().__init__()
+        self.conv1 = _ConvBN(3, 32, 3, stride=2)
+        self.conv2 = _ConvBN(32, 32, 3)
+        self.conv3 = _ConvBN(32, 64, 3, padding=1)
+        self.pool1 = MaxPool2D(3, stride=2)
+        self.conv4 = _ConvBN(64, 80, 1)
+        self.conv5 = _ConvBN(80, 192, 3)
+        self.pool2 = MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        x = self.pool1(self.conv3(self.conv2(self.conv1(x))))
+        return self.pool2(self.conv5(self.conv4(x)))
+
+
+class InceptionA(Layer):
+    """Reference: inceptionv3.py:109."""
+
+    def __init__(self, in_c, pool_features):
+        super().__init__()
+        self.b1 = _ConvBN(in_c, 64, 1)
+        self.b5 = Sequential(_ConvBN(in_c, 48, 1),
+                             _ConvBN(48, 64, 5, padding=2))
+        self.b3 = Sequential(_ConvBN(in_c, 64, 1),
+                             _ConvBN(64, 96, 3, padding=1),
+                             _ConvBN(96, 96, 3, padding=1))
+        self.pool = Sequential(AvgPool2D(3, stride=1, padding=1),
+                               _ConvBN(in_c, pool_features, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b5(x), self.b3(x), self.pool(x)], 1)
+
+
+class InceptionB(Layer):
+    """Grid reduction 35->17. Reference: inceptionv3.py:185."""
+
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = _ConvBN(in_c, 384, 3, stride=2)
+        self.b3dbl = Sequential(_ConvBN(in_c, 64, 1),
+                                _ConvBN(64, 96, 3, padding=1),
+                                _ConvBN(96, 96, 3, stride=2))
+        self.pool = MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.b3dbl(x), self.pool(x)], 1)
+
+
+class InceptionC(Layer):
+    """Factorized 7x7. Reference: inceptionv3.py:236."""
+
+    def __init__(self, in_c, c7):
+        super().__init__()
+        self.b1 = _ConvBN(in_c, 192, 1)
+        self.b7 = Sequential(
+            _ConvBN(in_c, c7, 1),
+            _ConvBN(c7, c7, (1, 7), padding=(0, 3)),
+            _ConvBN(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7dbl = Sequential(
+            _ConvBN(in_c, c7, 1),
+            _ConvBN(c7, c7, (7, 1), padding=(3, 0)),
+            _ConvBN(c7, c7, (1, 7), padding=(0, 3)),
+            _ConvBN(c7, c7, (7, 1), padding=(3, 0)),
+            _ConvBN(c7, 192, (1, 7), padding=(0, 3)))
+        self.pool = Sequential(AvgPool2D(3, stride=1, padding=1),
+                               _ConvBN(in_c, 192, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b7(x), self.b7dbl(x), self.pool(x)], 1)
+
+
+class InceptionD(Layer):
+    """Grid reduction 17->8. Reference: inceptionv3.py:342."""
+
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = Sequential(_ConvBN(in_c, 192, 1),
+                             _ConvBN(192, 320, 3, stride=2))
+        self.b7x3 = Sequential(
+            _ConvBN(in_c, 192, 1),
+            _ConvBN(192, 192, (1, 7), padding=(0, 3)),
+            _ConvBN(192, 192, (7, 1), padding=(3, 0)),
+            _ConvBN(192, 192, 3, stride=2))
+        self.pool = MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.b7x3(x), self.pool(x)], 1)
+
+
+class InceptionE(Layer):
+    """Expanded-filter-bank output blocks. Reference: inceptionv3.py:408."""
+
+    def __init__(self, in_c):
+        super().__init__()
+        self.b1 = _ConvBN(in_c, 320, 1)
+        self.b3_1 = _ConvBN(in_c, 384, 1)
+        self.b3_2a = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3_2b = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.b3dbl_1 = Sequential(_ConvBN(in_c, 448, 1),
+                                  _ConvBN(448, 384, 3, padding=1))
+        self.b3dbl_2a = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3dbl_2b = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.pool = Sequential(AvgPool2D(3, stride=1, padding=1),
+                               _ConvBN(in_c, 192, 1))
+
+    def forward(self, x):
+        b3 = self.b3_1(x)
+        b3 = concat([self.b3_2a(b3), self.b3_2b(b3)], 1)
+        b3dbl = self.b3dbl_1(x)
+        b3dbl = concat([self.b3dbl_2a(b3dbl), self.b3dbl_2b(b3dbl)], 1)
+        return concat([self.b1(x), b3, b3dbl, self.pool(x)], 1)
+
+
+class InceptionV3(Layer):
+    """Reference: inceptionv3.py:507."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = InceptionStem()
+        self.blocks = Sequential(
+            InceptionA(192, 32),
+            InceptionA(256, 64),
+            InceptionA(288, 64),
+            InceptionB(288),
+            InceptionC(768, 128),
+            InceptionC(768, 160),
+            InceptionC(768, 160),
+            InceptionC(768, 192),
+            InceptionD(768),
+            InceptionE(1280),
+            InceptionE(2048),
+        )
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = Dropout(0.2)
+            self.fc = Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.dropout(x)
+            x = flatten(x, 1)
+            x = self.fc(x)
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    model = InceptionV3(**kwargs)
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights are not bundled; load a converted state_dict")
+    return model

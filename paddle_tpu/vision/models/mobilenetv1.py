@@ -1,0 +1,95 @@
+"""MobileNetV1. Reference: python/paddle/vision/models/mobilenetv1.py
+(API-identical: MobileNetV1(scale, num_classes, with_pool), mobilenet_v1)."""
+from __future__ import annotations
+
+from ...nn import (
+    AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Layer, Linear, ReLU, Sequential,
+)
+from ...ops.manipulation import flatten
+
+__all__ = ["MobileNetV1", "mobilenet_v1"]
+
+
+class _ConvBNRelu(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, groups=1):
+        super().__init__()
+        self.conv = Conv2D(in_channels, out_channels, kernel_size,
+                           stride=stride, padding=padding, groups=groups,
+                           bias_attr=False)
+        self.bn = BatchNorm2D(out_channels)
+        self.relu = ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))
+
+
+class DepthwiseSeparable(Layer):
+    """3x3 depthwise conv + 1x1 pointwise conv. Reference: mobilenetv1.py:50."""
+
+    def __init__(self, in_channels, out_channels1, out_channels2, num_groups,
+                 stride, scale):
+        super().__init__()
+        self._depthwise = _ConvBNRelu(
+            in_channels, int(out_channels1 * scale), 3, stride=stride,
+            padding=1, groups=int(num_groups * scale))
+        self._pointwise = _ConvBNRelu(
+            int(out_channels1 * scale), int(out_channels2 * scale), 1)
+
+    def forward(self, x):
+        return self._pointwise(self._depthwise(x))
+
+
+class MobileNetV1(Layer):
+    """Reference: mobilenetv1.py:85."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.scale = scale
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        self.conv1 = _ConvBNRelu(3, int(32 * scale), 3, stride=2, padding=1)
+        # (in, dw_out, pw_out, groups, stride) ladder of the 13 DS blocks
+        cfg = [
+            (32, 32, 64, 32, 1),
+            (64, 64, 128, 64, 2),
+            (128, 128, 128, 128, 1),
+            (128, 128, 256, 128, 2),
+            (256, 256, 256, 256, 1),
+            (256, 256, 512, 256, 2),
+            (512, 512, 512, 512, 1),
+            (512, 512, 512, 512, 1),
+            (512, 512, 512, 512, 1),
+            (512, 512, 512, 512, 1),
+            (512, 512, 512, 512, 1),
+            (512, 512, 1024, 512, 2),
+            (1024, 1024, 1024, 1024, 1),
+        ]
+        blocks = [
+            DepthwiseSeparable(int(i * scale), d, p, g, s, scale)
+            for i, d, p, g, s in cfg
+        ]
+        self.blocks = Sequential(*blocks)
+        if with_pool:
+            self.pool2d_avg = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = Linear(int(1024 * scale), num_classes)
+
+    def forward(self, x):
+        x = self.conv1(x)
+        x = self.blocks(x)
+        if self.with_pool:
+            x = self.pool2d_avg(x)
+        if self.num_classes > 0:
+            x = flatten(x, 1)
+            x = self.fc(x)
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    model = MobileNetV1(scale=scale, **kwargs)
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights are not bundled; load a converted state_dict")
+    return model

@@ -1,0 +1,167 @@
+"""ShuffleNetV2. Reference: python/paddle/vision/models/shufflenetv2.py
+(API-identical: ShuffleNetV2(scale, act, num_classes, with_pool) + the seven
+shufflenet_v2_* constructors). Exercises channel_shuffle (reshape/transpose
+data movement) and channel-split residuals."""
+from __future__ import annotations
+
+from ...nn import (
+    AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Layer, Linear, MaxPool2D, ReLU,
+    Sequential, Swish,
+)
+from ...ops.manipulation import concat, flatten, reshape, split, transpose
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+           "shufflenet_v2_x2_0", "shufflenet_v2_swish"]
+
+_STAGE_OUT = {
+    0.25: [24, 24, 48, 96, 512],
+    0.33: [24, 32, 64, 128, 512],
+    0.5: [24, 48, 96, 192, 1024],
+    1.0: [24, 116, 232, 464, 1024],
+    1.5: [24, 176, 352, 704, 1024],
+    2.0: [24, 244, 488, 976, 2048],
+}
+_STAGE_REPEATS = [4, 8, 4]
+
+
+def channel_shuffle(x, groups):
+    """Interleave channel groups (NCHW). Reference: shufflenetv2.py:101."""
+    n, c, h, w = x.shape
+    x = reshape(x, [n, groups, c // groups, h, w])
+    x = transpose(x, [0, 2, 1, 3, 4])
+    return reshape(x, [n, c, h, w])
+
+
+def _act(name):
+    return Swish() if name == "swish" else ReLU()
+
+
+class _ConvBNAct(Sequential):
+    def __init__(self, in_c, out_c, kernel, stride=1, groups=1, act="relu"):
+        layers = [
+            Conv2D(in_c, out_c, kernel, stride=stride,
+                   padding=(kernel - 1) // 2, groups=groups, bias_attr=False),
+            BatchNorm2D(out_c),
+        ]
+        if act is not None:
+            layers.append(_act(act))
+        super().__init__(*layers)
+
+
+class InvertedResidual(Layer):
+    """Stride-1 unit: split channels, transform one half, shuffle.
+    Reference: shufflenetv2.py:118."""
+
+    def __init__(self, channels, act):
+        super().__init__()
+        half = channels // 2
+        self.branch = Sequential(
+            _ConvBNAct(half, half, 1, act=act),
+            _ConvBNAct(half, half, 3, groups=half, act=None),  # depthwise
+            _ConvBNAct(half, half, 1, act=act),
+        )
+
+    def forward(self, x):
+        x1, x2 = split(x, 2, axis=1)
+        out = concat([x1, self.branch(x2)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+class InvertedResidualDS(Layer):
+    """Stride-2 (downsample) unit: both halves transformed.
+    Reference: shufflenetv2.py:168."""
+
+    def __init__(self, in_c, out_c, act):
+        super().__init__()
+        half = out_c // 2
+        self.branch1 = Sequential(
+            _ConvBNAct(in_c, in_c, 3, stride=2, groups=in_c, act=None),
+            _ConvBNAct(in_c, half, 1, act=act),
+        )
+        self.branch2 = Sequential(
+            _ConvBNAct(in_c, half, 1, act=act),
+            _ConvBNAct(half, half, 3, stride=2, groups=half, act=None),
+            _ConvBNAct(half, half, 1, act=act),
+        )
+
+    def forward(self, x):
+        out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(Layer):
+    """Reference: shufflenetv2.py:237."""
+
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        if scale not in _STAGE_OUT:
+            raise ValueError(f"scale must be one of {sorted(_STAGE_OUT)}")
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        outs = _STAGE_OUT[scale]
+
+        self.conv1 = _ConvBNAct(3, outs[0], 3, stride=2, act=act)
+        self.max_pool = MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        in_c = outs[0]
+        for stage_idx, repeats in enumerate(_STAGE_REPEATS):
+            out_c = outs[stage_idx + 1]
+            stages.append(InvertedResidualDS(in_c, out_c, act))
+            for _ in range(repeats - 1):
+                stages.append(InvertedResidual(out_c, act))
+            in_c = out_c
+        self.stages = Sequential(*stages)
+        self.conv_last = _ConvBNAct(in_c, outs[-1], 1, act=act)
+        if with_pool:
+            self.pool2d_avg = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = Linear(outs[-1], num_classes)
+
+    def forward(self, x):
+        x = self.max_pool(self.conv1(x))
+        x = self.stages(x)
+        x = self.conv_last(x)
+        if self.with_pool:
+            x = self.pool2d_avg(x)
+        if self.num_classes > 0:
+            x = flatten(x, 1)
+            x = self.fc(x)
+        return x
+
+
+def _shufflenet(scale, act, pretrained, **kwargs):
+    model = ShuffleNetV2(scale=scale, act=act, **kwargs)
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights are not bundled; load a converted state_dict")
+    return model
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return _shufflenet(0.25, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return _shufflenet(0.33, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return _shufflenet(0.5, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return _shufflenet(1.0, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return _shufflenet(1.5, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return _shufflenet(2.0, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return _shufflenet(1.0, "swish", pretrained, **kwargs)

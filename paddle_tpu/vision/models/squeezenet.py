@@ -1,0 +1,101 @@
+"""SqueezeNet. Reference: python/paddle/vision/models/squeezenet.py
+(API-identical: SqueezeNet(version, num_classes, with_pool), squeezenet1_0/1_1)."""
+from __future__ import annotations
+
+from ...nn import (
+    AdaptiveAvgPool2D, Conv2D, Dropout, Layer, MaxPool2D, ReLU, Sequential,
+)
+from ...ops.manipulation import concat, flatten
+
+__all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
+
+
+class _Fire(Layer):
+    """squeeze 1x1 -> expand 1x1 + expand 3x3, concatenated on channels."""
+
+    def __init__(self, in_channels, squeeze, expand1x1, expand3x3):
+        super().__init__()
+        self.squeeze = Conv2D(in_channels, squeeze, 1)
+        self.relu = ReLU()
+        self.expand1x1 = Conv2D(squeeze, expand1x1, 1)
+        self.expand3x3 = Conv2D(squeeze, expand3x3, 3, padding=1)
+
+    def forward(self, x):
+        x = self.relu(self.squeeze(x))
+        a = self.relu(self.expand1x1(x))
+        b = self.relu(self.expand3x3(x))
+        return concat([a, b], axis=1)
+
+
+class SqueezeNet(Layer):
+    """Reference: squeezenet.py (class SqueezeNet)."""
+
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        if version not in ("1.0", "1.1"):
+            raise ValueError("version must be '1.0' or '1.1'")
+        self.version = version
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if version == "1.0":
+            self.features = Sequential(
+                Conv2D(3, 96, 7, stride=2), ReLU(),
+                MaxPool2D(3, stride=2, ceil_mode=True),
+                _Fire(96, 16, 64, 64),
+                _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128),
+                MaxPool2D(3, stride=2, ceil_mode=True),
+                _Fire(256, 32, 128, 128),
+                _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256),
+                MaxPool2D(3, stride=2, ceil_mode=True),
+                _Fire(512, 64, 256, 256),
+            )
+        else:
+            self.features = Sequential(
+                Conv2D(3, 64, 3, stride=2), ReLU(),
+                MaxPool2D(3, stride=2, ceil_mode=True),
+                _Fire(64, 16, 64, 64),
+                _Fire(128, 16, 64, 64),
+                MaxPool2D(3, stride=2, ceil_mode=True),
+                _Fire(128, 32, 128, 128),
+                _Fire(256, 32, 128, 128),
+                MaxPool2D(3, stride=2, ceil_mode=True),
+                _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256),
+                _Fire(512, 64, 256, 256),
+            )
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Dropout(0.5),
+                Conv2D(512, num_classes, 1),
+                ReLU(),
+            )
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D((1, 1))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.num_classes > 0:
+            x = self.classifier(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        return flatten(x, 1) if self.num_classes > 0 else x
+
+
+def _squeezenet(version, pretrained, **kwargs):
+    model = SqueezeNet(version, **kwargs)
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights are not bundled; load a converted state_dict")
+    return model
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    return _squeezenet("1.0", pretrained, **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    return _squeezenet("1.1", pretrained, **kwargs)

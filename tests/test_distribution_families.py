@@ -399,3 +399,39 @@ def test_independent_negative_rank_raises():
         D.Independent(base, -1)
     with pytest.raises(ValueError):
         D.Independent(base, 3)
+
+
+def test_eager_cache_no_bound_method_collision():
+    """Two instances of a stateful Transform class must not share a vjp-cache
+    entry (review regression: cache keyed only on __code__+cells)."""
+    x = paddle.to_tensor(np.array([1.0], "float32"), stop_gradient=False)
+    a = D.ChainTransform([D.ExpTransform()]).forward(x)
+    b = D.ChainTransform([D.TanhTransform()]).forward(x)
+    np.testing.assert_allclose(p2n(a), np.exp(1.0), rtol=1e-5)
+    np.testing.assert_allclose(p2n(b), np.tanh(1.0), rtol=1e-5)
+    r1 = D.ReshapeTransform((6,), (2, 3)).forward(
+        paddle.to_tensor(np.zeros(6, "float32"), stop_gradient=False))
+    r2 = D.ReshapeTransform((6,), (3, 2)).forward(
+        paddle.to_tensor(np.zeros(6, "float32"), stop_gradient=False))
+    assert p2n(r1).shape == (2, 3) and p2n(r2).shape == (3, 2)
+
+
+def test_eager_cache_lambda_defaults_keyed():
+    """Lambdas differing only in __defaults__ must not collide (review
+    regression: sum_rightmost n=... was invisible to the cache key)."""
+    val6 = paddle.to_tensor(np.abs(np.random.RandomState(0).randn(6))
+                            .astype("float32"), stop_gradient=False)
+    td_reshape = D.TransformedDistribution(
+        D.Normal(np.zeros(6, "float32"), np.ones(6, "float32")),
+        [D.ReshapeTransform((6,), (2, 3))])
+    td_reshape.log_prob(paddle.to_tensor(
+        np.random.RandomState(1).randn(2, 3).astype("float32"),
+        stop_gradient=False))  # seeds the cache with n=1 reductions
+    td_exp = D.TransformedDistribution(
+        D.Normal(np.zeros(6, "float32"), np.ones(6, "float32")),
+        [D.ExpTransform()])
+    got = p2n(td_exp.log_prob(val6))
+    want = p2n(D.LogNormal(np.zeros(6, "float32"),
+                           np.ones(6, "float32")).log_prob(val6))
+    assert got.shape == (6,)
+    np.testing.assert_allclose(got, want, rtol=1e-5)

@@ -1,0 +1,117 @@
+"""Vision model zoo: forward shapes + train-step smoke per family + export
+parity with the reference python/paddle/vision/models/__init__.py."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models
+
+
+def _n_params(model):
+    return sum(int(np.prod(p.shape)) for p in model.parameters())
+
+
+# small inputs where the architecture allows; inception needs 299, others 224
+@pytest.mark.parametrize("ctor, in_shape, n_out", [
+    (lambda: models.LeNet(num_classes=10), (2, 1, 28, 28), 10),
+    (lambda: models.AlexNet(num_classes=7), (2, 3, 224, 224), 7),
+    (lambda: models.vgg11(num_classes=7), (2, 3, 224, 224), 7),
+    (lambda: models.vgg16(batch_norm=True, num_classes=7), (1, 3, 224, 224), 7),
+    (lambda: models.mobilenet_v1(scale=0.25, num_classes=7), (2, 3, 224, 224), 7),
+    (lambda: models.mobilenet_v2(scale=0.25, num_classes=7), (2, 3, 224, 224), 7),
+    (lambda: models.mobilenet_v3_small(num_classes=7), (2, 3, 224, 224), 7),
+    (lambda: models.mobilenet_v3_large(num_classes=7), (1, 3, 224, 224), 7),
+    (lambda: models.densenet121(num_classes=7), (1, 3, 224, 224), 7),
+    (lambda: models.inception_v3(num_classes=7), (1, 3, 299, 299), 7),
+    (lambda: models.squeezenet1_0(num_classes=7), (2, 3, 224, 224), 7),
+    (lambda: models.squeezenet1_1(num_classes=7), (2, 3, 224, 224), 7),
+    (lambda: models.shufflenet_v2_x0_25(num_classes=7), (2, 3, 224, 224), 7),
+    (lambda: models.shufflenet_v2_swish(num_classes=7), (1, 3, 224, 224), 7),
+    (lambda: models.resnext50_32x4d(num_classes=7), (1, 3, 224, 224), 7),
+])
+def test_forward_shape(ctor, in_shape, n_out):
+    model = ctor()
+    model.eval()
+    x = paddle.to_tensor(np.random.RandomState(0).randn(*in_shape)
+                         .astype("float32"))
+    out = model(x)
+    assert list(out.shape) == [in_shape[0], n_out]
+
+
+def test_googlenet_aux_outputs():
+    model = models.googlenet(num_classes=7)
+    model.eval()
+    x = paddle.to_tensor(np.random.RandomState(0).randn(1, 3, 224, 224)
+                         .astype("float32"))
+    out, aux1, aux2 = model(x)
+    assert list(out.shape) == [1, 7]
+    assert list(aux1.shape) == [1, 7]
+    assert list(aux2.shape) == [1, 7]
+
+
+def test_param_counts_sane():
+    # reference param counts (torchvision-equivalent architectures), ~1% slack
+    expect = {
+        "alexnet": 61.1e6,
+        "vgg16": 138.4e6,
+        "mobilenet_v2": 3.50e6,
+        "squeezenet1_0": 1.25e6,
+        "densenet121": 7.98e6,
+        "shufflenet_v2_x1_0": 2.28e6,
+        "inception_v3": 23.8e6,
+        "resnext50_32x4d": 25.0e6,
+        "mobilenet_v3_large": 5.48e6,
+    }
+    for name, n in expect.items():
+        model = getattr(models, name)()
+        got = _n_params(model)
+        assert abs(got - n) / n < 0.02, f"{name}: {got} vs {n}"
+
+
+@pytest.mark.parametrize("ctor, in_shape", [
+    (lambda: models.LeNet(num_classes=10), (4, 1, 28, 28)),
+    (lambda: models.mobilenet_v3_small(scale=1.0, num_classes=10),
+     (2, 3, 64, 64)),
+    (lambda: models.shufflenet_v2_x0_25(num_classes=10), (2, 3, 64, 64)),
+    (lambda: models.densenet121(num_classes=10), (2, 3, 64, 64)),
+])
+def test_train_step(ctor, in_shape):
+    model = ctor()
+    model.train()
+    opt = paddle.optimizer.SGD(parameters=model.parameters(),
+                               learning_rate=0.005)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(*in_shape)
+                         .astype("float32"))
+    y = paddle.to_tensor(np.random.RandomState(1).randint(0, 10,
+                                                          (in_shape[0],)))
+    loss_fn = paddle.nn.CrossEntropyLoss()
+    losses = []
+    for _ in range(3):
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_export_parity_with_reference():
+    ref_all = [
+        'ResNet', 'resnet18', 'resnet34', 'resnet50', 'resnet101',
+        'resnet152', 'resnext50_32x4d', 'resnext50_64x4d', 'resnext101_32x4d',
+        'resnext101_64x4d', 'resnext152_32x4d', 'resnext152_64x4d',
+        'wide_resnet50_2', 'wide_resnet101_2', 'VGG', 'vgg11', 'vgg13',
+        'vgg16', 'vgg19', 'MobileNetV1', 'mobilenet_v1', 'MobileNetV2',
+        'mobilenet_v2', 'MobileNetV3Small', 'MobileNetV3Large',
+        'mobilenet_v3_small', 'mobilenet_v3_large', 'LeNet', 'DenseNet',
+        'densenet121', 'densenet161', 'densenet169', 'densenet201',
+        'densenet264', 'AlexNet', 'alexnet', 'InceptionV3', 'inception_v3',
+        'SqueezeNet', 'squeezenet1_0', 'squeezenet1_1', 'GoogLeNet',
+        'googlenet', 'ShuffleNetV2', 'shufflenet_v2_x0_25',
+        'shufflenet_v2_x0_33', 'shufflenet_v2_x0_5', 'shufflenet_v2_x1_0',
+        'shufflenet_v2_x1_5', 'shufflenet_v2_x2_0', 'shufflenet_v2_swish',
+    ]
+    missing = set(ref_all) - set(models.__all__)
+    assert not missing, f"missing: {missing}"
+    for name in ref_all:
+        assert hasattr(models, name)
