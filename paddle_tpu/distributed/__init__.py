@@ -19,6 +19,13 @@ from .collective import (  # noqa: F401
     recv, reduce, reduce_scatter, scatter, send, wait,
 )
 from .parallel import DataParallel  # noqa: F401
+from . import auto_parallel  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    ColWiseParallel, DistModel, LocalLayer, PrepareLayerInput,
+    PrepareLayerOutput, RowWiseParallel, SequenceParallelBegin,
+    SequenceParallelDisable, SequenceParallelEnable, SequenceParallelEnd,
+    SplitPoint, Strategy, parallelize, to_static,
+)
 from . import context_parallel  # noqa: F401
 from .context_parallel import (  # noqa: F401
     RingFlashAttention, SegmentParallel, ring_attention, ulysses_attention,

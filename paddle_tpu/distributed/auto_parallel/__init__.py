@@ -1,0 +1,12 @@
+"""Auto-parallel: plan-based parallelize API + static DistModel engine.
+
+Reference: python/paddle/distributed/auto_parallel/ (api.py, strategy.py,
+intermediate/)."""
+from .dist_model import DistModel, LocalLayer, to_static  # noqa: F401
+from .parallelize import (  # noqa: F401
+    ColWiseParallel, PlanBase, PrepareLayerInput, PrepareLayerOutput,
+    RowWiseParallel, SequenceParallelBegin, SequenceParallelDisable,
+    SequenceParallelEnable, SequenceParallelEnd, SplitPoint, parallelize,
+)
+from .strategy import Strategy  # noqa: F401
+from ..mesh import get_mesh, set_mesh  # noqa: F401

@@ -1,0 +1,279 @@
+"""dist.to_static -> DistModel: the auto-parallel static training engine.
+
+Reference: python/paddle/distributed/auto_parallel/api.py:2952 (to_static) and
+:2254 (DistModel). The reference traces the model to PIR, runs
+mix_to_dist/partition/reshard passes and executes through PirInterpreter; the
+TPU-native engine is far shorter because XLA owns those passes:
+
+- non-pipeline: ONE compiled XLA program per step (jit/train.py TrainStep —
+  forward, backward, clip, optimizer update), batch sharded over the mesh 'dp'
+  axis, parameters carrying their plan-assigned 'mp' shardings, ZeRO layouts
+  from the Strategy/parallelize sharding level. GSPMD inserts every collective.
+- pipeline (model annotated by parallelize's pp split): per-(stage,phase)
+  compiled programs driven by fleet's PipelineEngine instruction streams
+  (1F1B/FThenB/VPP — reference pipeline_scheduler_pass analog).
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from ...tensor import Tensor
+from ..mesh import get_mesh
+from .strategy import Strategy
+
+__all__ = ["DistModel", "to_static", "LocalLayer"]
+
+
+class DistModel:
+    """Reference api.py:2254. Modes: train (loss+optimizer), eval (loss),
+    predict. __call__ runs one step of the current mode."""
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None,
+                 strategy=None, metrics=None):
+        self.network = layer
+        self._loader = loader
+        self._loss = loss
+        self._optimizer = optimizer
+        self._strategy = strategy or Strategy()
+        self._metrics = metrics or []
+        self._mesh = getattr(layer, "_pp_mesh", None) or get_mesh()
+        self._engine = None
+        self._train_step = None
+        self._feed_names = None
+
+        if loss is not None and optimizer is not None:
+            self._mode = "train"
+        elif loss is not None:
+            self._mode = "eval"
+        else:
+            self._mode = "predict"
+
+        self._is_pp = getattr(layer, "_pp_chain", None) is not None
+        sharding = self._strategy.sharding
+        if (sharding.enable and optimizer is not None
+                and not hasattr(optimizer, "_shard_fn")):
+            from ..api import (
+                ShardingStage1, ShardingStage2, ShardingStage3, shard_optimizer,
+            )
+
+            stage_cls = {1: ShardingStage1, 2: ShardingStage2,
+                         3: ShardingStage3}[int(sharding.stage)]
+            self._optimizer = shard_optimizer(optimizer,
+                                              stage_cls("dp", self._mesh))
+
+    # ------------------------------------------------------------- mode API
+    def train(self):
+        if self._loss is None or self._optimizer is None:
+            raise RuntimeError(
+                "DistModel needs both loss and optimizer for train mode")
+        self._mode = "train"
+        self.network.train()
+        return self
+
+    def eval(self):
+        if self._loss is None:
+            raise RuntimeError("DistModel needs a loss for eval mode")
+        self._mode = "eval"
+        self.network.eval()
+        return self
+
+    def predict(self):
+        self._mode = "predict"
+        self.network.eval()
+        return self
+
+    # ------------------------------------------------------------ execution
+    def _amp_ctx(self):
+        import contextlib
+
+        amp = self._strategy.amp
+        if not amp.enable:
+            return contextlib.nullcontext()
+        from ... import amp as amp_mod
+
+        return amp_mod.auto_cast(
+            enable=True, level=amp.level.upper(), dtype=amp.dtype,
+            custom_black_list=list(amp.custom_black_list) or None,
+            custom_white_list=list(amp.custom_white_list) or None)
+
+    def _shard_batch(self, args):
+        """Lay each batch arg out over the mesh dp axis (dim 0)."""
+        if (self._mesh is None or self._is_pp
+                or "dp" not in self._mesh.dim_names):
+            return args
+        dp = self._mesh.get_dim_size("dp")
+        if dp <= 1:
+            return args
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        out = []
+        for a in args:
+            t = a if isinstance(a, Tensor) else Tensor(jax.numpy.asarray(a))
+            if t.ndim >= 1 and t.shape[0] % dp == 0:
+                sh = NamedSharding(
+                    self._mesh.jax_mesh,
+                    PartitionSpec("dp", *([None] * (t.ndim - 1))))
+                t._value = jax.device_put(t._value, sh)
+            out.append(t)
+        return tuple(out)
+
+    def _ensure_train_step(self):
+        if self._train_step is None:
+            from ...jit.train import TrainStep
+
+            self._train_step = TrainStep(
+                self.network, self._loss, self._optimizer, split_label=True)
+        return self._train_step
+
+    def _ensure_engine(self):
+        if self._engine is None:
+            from ..fleet.meta_parallel import PipelineLayer
+            from ..fleet.pipeline import PipelineEngine, StagePlacement, _Chunk
+            from jax.sharding import Mesh as JaxMesh
+
+            chain = self.network._pp_chain
+            bounds = self.network._pp_bounds
+            pcfg = self._strategy.pipeline
+            vpp = max(1, int(pcfg.vpp_degree))
+            p = len(bounds) - 1
+            if vpp > 1:
+                # re-split the chain into p*vpp chunks, round-robin placement
+                n = len(chain)
+                nb = [0]
+                for i in range(1, p * vpp + 1):
+                    nb.append((n * i) // (p * vpp))
+                chunk_bounds = nb
+            else:
+                chunk_bounds = bounds
+            chunks = [
+                _Chunk([layer for _, layer in
+                        chain[chunk_bounds[c]:chunk_bounds[c + 1]]])
+                for c in range(len(chunk_bounds) - 1)
+            ]
+            mesh = self._mesh
+            pp_idx = mesh.dim_names.index("pp")
+            grid = np.moveaxis(np.asarray(mesh.jax_mesh.devices), pp_idx, 0)
+            other_axes = tuple(n for i, n in enumerate(mesh.dim_names)
+                               if i != pp_idx)
+            zero = 0
+            sf = getattr(self._optimizer, "_shard_fn", None)
+            if sf is not None:
+                zero = (3 if sf.shard_params else (2 if sf.shard_grads else 1))
+            stage_places = []
+            for i in range(grid.shape[0]):
+                sub = grid[i]
+                if sub.size == 1:
+                    stage_places.append(
+                        StagePlacement(device=sub.reshape(-1)[0]))
+                else:
+                    stage_places.append(StagePlacement(
+                        mesh=JaxMesh(sub, other_axes), zero_stage=zero))
+            placements = [stage_places[c % p] for c in range(len(chunks))]
+            self._engine = PipelineEngine(chunks, placements, self._loss)
+        return self._engine
+
+    def _pp_step(self, x, label):
+        from ...ops.manipulation import split
+
+        engine = self._ensure_engine()
+        n_micro = max(1, int(self._strategy.pipeline.accumulate_steps))
+        xs = split(x, n_micro, axis=0) if n_micro > 1 else [x]
+        ys = split(label, n_micro, axis=0) if n_micro > 1 else [label]
+        mean_loss, grads = engine.run(
+            [m._value for m in xs], [m._value for m in ys], 1.0)
+        for t, g in grads.values():
+            t._grad = Tensor(g) if t._grad is None else Tensor(t._grad._value + g)
+        self._optimizer.step()
+        self._optimizer.clear_grad()
+        return Tensor(mean_loss)
+
+    def __call__(self, *args):
+        args = tuple(
+            a if isinstance(a, Tensor) else Tensor(jax.numpy.asarray(a))
+            for a in args)
+        if self._mode == "train":
+            with self._amp_ctx():
+                if self._is_pp:
+                    *xs, label = args
+                    return self._pp_step(xs[0] if len(xs) == 1 else xs, label)
+                args = self._shard_batch(args)
+                return self._ensure_train_step()(*args)
+        if self._mode == "eval":
+            from ...autograd import tape
+
+            *xs, label = args
+            with tape.no_grad(), self._amp_ctx():
+                out = self.network(*xs)
+                return self._loss(out, label)
+        from ...autograd import tape
+
+        with tape.no_grad(), self._amp_ctx():
+            return self.network(*args)
+
+    # ------------------------------------------------------------- state API
+    def state_dict(self, mode="all"):
+        """mode: 'all' (params+buffers+optimizer), 'model', or 'opt'."""
+        model_sd = dict(self.network.state_dict())
+        opt_sd = {}
+        if mode in ("all", "opt") and self._optimizer is not None:
+            inner = getattr(self._optimizer, "_inner_opt", self._optimizer)
+            params_by_id = {id(t): k for k, t in model_sd.items()}
+            for acc_name, store in getattr(inner, "_accumulators", {}).items():
+                for pid, v in store.items():
+                    pname = params_by_id.get(pid)
+                    if pname is not None:
+                        opt_sd[f"{pname}.{acc_name}"] = Tensor(v)
+        if mode == "opt":
+            return opt_sd
+        if mode == "model":
+            return model_sd
+        model_sd.update(opt_sd)
+        return model_sd
+
+    def set_state_dict(self, state_dict):
+        self.network.set_state_dict(state_dict)
+
+    def dist_main_program(self, mode=None):
+        """No Program object exists in the trace-and-compile world (jaxpr /
+        StableHLO replace it); kept for reference API shape."""
+        return None
+
+    def dist_startup_program(self, mode=None):
+        return None
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None,
+              metrics=None):
+    """Reference api.py:2952: build the static auto-parallel engine around a
+    (possibly parallelize'd / shard_tensor-annotated) dygraph model.
+    Returns a DistModel."""
+    return DistModel(layer, loader, loss, optimizer, strategy, metrics)
+
+
+from ...nn.layer import Layer as _Layer  # noqa: E402
+
+
+class LocalLayer(_Layer):
+    """Reference: auto_parallel/local_layer.py:27 — a layer whose forward runs
+    on local shards; outputs are re-marked with the declared placements.
+    TPU-native: inside a compiled program GSPMD already executes ops on local
+    shards, so LocalLayer reduces to applying `out_dist_attrs` to outputs."""
+
+    def __init__(self, out_dist_attrs=None):
+        super().__init__()
+        self.out_dist_attrs = list(out_dist_attrs or [])
+
+    def __call__(self, *args, **kwargs):
+        out = super().__call__(*args, **kwargs)
+        if not self.out_dist_attrs:
+            return out
+        from ..api import shard_tensor
+
+        single = not isinstance(out, (tuple, list))
+        outs = [out] if single else list(out)
+        for i, t in enumerate(outs):
+            if i < len(self.out_dist_attrs) and isinstance(t, Tensor):
+                mesh, placements = self.out_dist_attrs[i]
+                outs[i] = shard_tensor(t, mesh, placements)
+        return outs[0] if single else type(out)(outs)

@@ -1,0 +1,354 @@
+"""The `parallelize` plan API — one call turns a plain model hybrid-parallel.
+
+Reference: python/paddle/distributed/auto_parallel/intermediate/parallelize.py:51
+(parallelize), intermediate/tensor_parallel.py (PlanBase:95, ColWiseParallel:103,
+RowWiseParallel:211, PrepareLayerInput:308, PrepareLayerOutput:363,
+SequenceParallelBegin:418, SequenceParallelEnd:470, SequenceParallelEnable:522,
+SequenceParallelDisable:579), intermediate/pipeline_parallel.py:30 (SplitPoint).
+
+TPU-native mechanics: a plan entry shards the matched layer's parameters over
+the mesh 'mp' axis (GSPMD inserts the TP collectives at compile time — no
+c_identity/c_allreduce ops), sequence-parallel plans place
+with_sharding_constraint hooks on activations (seq dim over 'mp'), and the
+pipeline split annotates the model with an ordered stage decomposition consumed
+by DistModel's pipeline engine (fleet/pipeline.py).
+"""
+from __future__ import annotations
+
+import re
+import warnings
+from enum import Enum
+
+from ...nn.layer import Layer
+from ..api import ShardingStage1, ShardingStage2, ShardingStage3, shard_optimizer, shard_tensor
+from ..mesh import Replicate, Shard, constrain, get_mesh
+
+__all__ = [
+    "ColWiseParallel", "RowWiseParallel", "PlanBase", "PrepareLayerInput",
+    "PrepareLayerOutput", "SequenceParallelBegin", "SequenceParallelDisable",
+    "SequenceParallelEnable", "SequenceParallelEnd", "SplitPoint",
+    "parallelize",
+]
+
+
+class SplitPoint(Enum):
+    """Reference: intermediate/pipeline_parallel.py:30."""
+
+    BEGINNING = 0
+    END = 1
+
+
+# ---------------------------------------------------------------- mp plans
+def _shard_param(param, mesh, dim):
+    """Annotate `param` Shard(dim) along 'mp' (no-op when impossible)."""
+    if param is None or "mp" not in mesh.dim_names:
+        return
+    idx = mesh.dim_names.index("mp")
+    if mesh.shape[idx] <= 1 or dim >= param.ndim:
+        return
+    if param.shape[dim] % mesh.shape[idx] != 0:
+        warnings.warn(
+            f"parallelize: cannot shard dim {dim} of shape {param.shape} "
+            f"over mp={mesh.shape[idx]}; leaving replicated")
+        return
+    placements = [Replicate()] * mesh.ndim
+    placements[idx] = Shard(dim)
+    shard_tensor(param, mesh, placements)
+    param.is_distributed = True
+
+
+def _seq_constrain(x, shard: bool):
+    """Pin (or release) the sequence dim (dim 1 of [b, s, ...]) over 'mp'."""
+    from ...tensor import Tensor
+
+    if not isinstance(x, Tensor) or x.ndim < 2:
+        return x
+    entries = [None] * x.ndim
+    if shard:
+        entries[1] = "mp"
+    x._value = constrain(x._value, entries, force=not shard)
+    return x
+
+
+class PlanBase:
+    """Reference tensor_parallel.py:95. apply(layer, process_mesh,
+    shard_param_list) mutates the matched layer in place."""
+
+    def apply(self, layer, process_mesh, shard_param_list=None):
+        raise NotImplementedError
+
+
+class ColWiseParallel(PlanBase):
+    """Shard a Linear's output dim / an Embedding's feature dim over 'mp'.
+
+    Reference tensor_parallel.py:103: Linear weight [in, out] -> Shard(1),
+    bias -> Shard(0); Embedding weight [vocab, h] -> Shard(1)."""
+
+    def __init__(self, gather_output=False):
+        self.gather_output = gather_output
+
+    def apply(self, layer, process_mesh, shard_param_list=None):
+        names = shard_param_list or ("weight", "bias")
+        for name in names:
+            p = getattr(layer, name, None)
+            if p is None:
+                continue
+            _shard_param(p, process_mesh, 1 if p.ndim >= 2 else 0)
+        if self.gather_output:
+            layer.register_forward_post_hook(
+                lambda l, inp, out: _gather_last_dim(out))
+
+
+def _gather_last_dim(out):
+    from ...tensor import Tensor
+
+    if isinstance(out, Tensor):
+        out._value = constrain(out._value, [None] * out.ndim, force=True)
+    return out
+
+
+class RowWiseParallel(PlanBase):
+    """Shard a Linear's input dim / an Embedding's vocab dim over 'mp'.
+
+    Reference tensor_parallel.py:211: weight [in, out] -> Shard(0); bias
+    replicated (the partial matmul results sum via GSPMD's psum)."""
+
+    def __init__(self, is_input_parallel=True):
+        self.is_input_parallel = is_input_parallel
+
+    def apply(self, layer, process_mesh, shard_param_list=None):
+        names = shard_param_list or ("weight",)
+        for name in names:
+            p = getattr(layer, name, None)
+            if p is None:
+                continue
+            _shard_param(p, process_mesh, 0)
+
+
+class PrepareLayerInput(PlanBase):
+    """Reference tensor_parallel.py:308: fn(process_mesh) returns a forward
+    pre-hook `hook(layer, inputs)`."""
+
+    def __init__(self, fn=None):
+        self.fn = fn
+
+    def apply(self, layer, process_mesh, shard_param_list=None):
+        layer.register_forward_pre_hook(self.fn(process_mesh))
+
+
+class PrepareLayerOutput(PlanBase):
+    """Reference tensor_parallel.py:363: fn(process_mesh) returns a forward
+    post-hook `hook(layer, inputs, outputs)`."""
+
+    def __init__(self, fn=None):
+        self.fn = fn
+
+    def apply(self, layer, process_mesh, shard_param_list=None):
+        layer.register_forward_post_hook(self.fn(process_mesh))
+
+
+class SequenceParallelBegin(PlanBase):
+    """After this layer, activations are sequence-sharded over 'mp'.
+    Reference tensor_parallel.py:418."""
+
+    def __init__(self, need_transpose=True):
+        self.need_transpose = need_transpose
+
+    def apply(self, layer, process_mesh, shard_param_list=None):
+        layer.register_forward_post_hook(
+            lambda l, inp, out: _seq_constrain(out, True))
+
+
+class SequenceParallelEnd(PlanBase):
+    """Before this layer, activations return to replicated-sequence.
+    Reference tensor_parallel.py:470."""
+
+    def __init__(self, need_transpose=True):
+        self.need_transpose = need_transpose
+
+    def apply(self, layer, process_mesh, shard_param_list=None):
+        def pre(l, inputs):
+            return tuple(_seq_constrain(x, False) for x in inputs)
+
+        layer.register_forward_pre_hook(pre)
+
+
+class SequenceParallelEnable(PlanBase):
+    """Run this layer sequence-parallel: input and output stay seq-sharded.
+    Reference tensor_parallel.py:522."""
+
+    def apply(self, layer, process_mesh, shard_param_list=None):
+        def pre(l, inputs):
+            return tuple(_seq_constrain(x, True) for x in inputs)
+
+        layer.register_forward_pre_hook(pre)
+        layer.register_forward_post_hook(
+            lambda l, inp, out: _seq_constrain(out, True))
+
+
+class SequenceParallelDisable(PlanBase):
+    """Run this layer on the full sequence inside an SP region.
+    Reference tensor_parallel.py:579."""
+
+    def __init__(self, need_transpose=True):
+        self.need_transpose = need_transpose
+
+    def apply(self, layer, process_mesh, shard_param_list=None):
+        def pre(l, inputs):
+            return tuple(_seq_constrain(x, False) for x in inputs)
+
+        layer.register_forward_pre_hook(pre)
+        layer.register_forward_post_hook(
+            lambda l, inp, out: _seq_constrain(out, True))
+
+
+# ---------------------------------------------------------------- matching
+def _match_layers(model, pattern):
+    """Layer-name -> sublayer matches for one plan key (exact, then regex —
+    mirroring the reference's re.fullmatch over named sublayers)."""
+    out = []
+    for name, sub in model.named_sublayers():
+        if name == pattern or re.fullmatch(pattern, name):
+            out.append((name, sub))
+    return out
+
+
+def tensor_parallel(model, parallelize_plan, mesh):
+    """Apply an mp parallelize_plan in place. Reference:
+    intermediate/tensor_parallel.py (tensor_parallel fn)."""
+    if parallelize_plan is None:
+        return model
+    for key, plan in parallelize_plan.items():
+        plans = plan if isinstance(plan, (list, tuple)) else [plan]
+        shard_param_list = None
+        layer_key = key
+        # param-level entry: "path.weight" / "path.bias" targets one param;
+        # the separator may be a plain '.' or an escaped '\.' in regex keys
+        m = re.search(r"(?:\\\.|\.)(weight|bias)$", key)
+        if m:
+            layer_key = key[:m.start()]
+            shard_param_list = [m.group(1)]
+        matches = _match_layers(model, layer_key)
+        if not matches:
+            warnings.warn(f"parallelize: plan key {key!r} matched no layer")
+        for _, sub in matches:
+            for p in plans:
+                p.apply(sub, mesh, shard_param_list)
+    return model
+
+
+# ---------------------------------------------------------------- pp split
+def _flatten_chain(model):
+    """Ordered (qualified_name, atomic_layer) chain from the model's immediate
+    structure, flattening Sequential/LayerList containers. Valid when the
+    model's forward applies its children sequentially (the same structural
+    assumption the reference's split_spec makes)."""
+    from ...nn.layer_common import LayerList, Sequential
+
+    chain = []
+
+    def walk(prefix, layer):
+        for name, child in layer.named_children():
+            qual = f"{prefix}.{name}" if prefix else name
+            if isinstance(child, (LayerList, Sequential)):
+                walk(qual, child)
+            else:
+                chain.append((qual, child))
+
+    walk("", model)
+    return chain
+
+
+def pipeline_parallel(model, optimizer, split_spec, global_spec=None,
+                      mesh=None):
+    """Annotate `model` with its pipeline-stage decomposition.
+
+    Reference: intermediate/pipeline_parallel.py (pipeline_parallel fn). The
+    annotation (`_pp_chain`, `_pp_bounds`) is consumed by DistModel, which
+    drives the per-stage compiled programs through fleet's PipelineEngine."""
+    mesh = mesh or get_mesh()
+    pp = mesh.get_dim_size("pp") if "pp" in mesh.dim_names else 1
+    if pp <= 1:
+        return model
+    chain = _flatten_chain(model)
+    names = [n for n, _ in chain]
+
+    if isinstance(split_spec, str):
+        # prefix form: split the matching layer run evenly into pp stages
+        region = [i for i, n in enumerate(names)
+                  if n == split_spec or n.startswith(split_spec + ".")]
+        if not region:
+            raise ValueError(f"split_spec {split_spec!r} matched no layers")
+        lo, hi = region[0], region[-1] + 1
+        span = hi - lo
+        bounds = [0]
+        for s in range(1, pp):
+            bounds.append(lo + (span * s) // pp)
+        bounds.append(len(chain))
+    else:
+        cut_points = []
+        for key, point in split_spec.items():
+            idx = [i for i, n in enumerate(names)
+                   if n == key or re.fullmatch(key, n)]
+            if not idx:
+                raise ValueError(f"split_spec key {key!r} matched no layer")
+            for i in idx:
+                cut_points.append(i if point == SplitPoint.BEGINNING else i + 1)
+        bounds = [0] + sorted(set(cut_points)) + [len(chain)]
+        bounds = sorted(set(bounds))
+        if len(bounds) - 1 != pp:
+            raise ValueError(
+                f"split_spec produces {len(bounds) - 1} stages but the mesh "
+                f"pp axis is {pp}")
+    if global_spec:
+        warnings.warn(
+            "parallelize: global_spec layers are kept replicated across "
+            "stages (single-host engine shares the parameter object)")
+    model._pp_chain = chain
+    model._pp_bounds = bounds
+    model._pp_mesh = mesh
+    return model
+
+
+# ---------------------------------------------------------------- top level
+def sharded_data_parallel(model, optimizer, level, mesh=None):
+    """Reference: intermediate/sharded_data_parallel.py — maps sharding_level
+    to the ZeRO stage recipes enforced inside TrainStep's compiled program."""
+    if optimizer is None or not level:
+        return model, optimizer
+    stages = {1: ShardingStage1, 2: ShardingStage2, 3: ShardingStage3}
+    stage = stages[int(level)]("dp", mesh)
+    return model, shard_optimizer(optimizer, stage)
+
+
+def parallelize(model: Layer, optimizer=None, mesh=None, config=None):
+    """Reference: intermediate/parallelize.py:51. config keys: dp_config
+    {sharding_level}, mp_config {parallelize_plan}, pp_config {split_spec,
+    global_spec}. Returns (model, optimizer)."""
+    mesh = mesh or get_mesh()
+    config = dict(config or {})
+    known = {"dp_config", "mp_config", "pp_config"}
+    unknown = set(config) - known
+    if unknown:
+        raise ValueError(f"unknown parallelize config keys: {sorted(unknown)}")
+    if mesh is None:
+        if config:
+            warnings.warn(
+                "parallelize: no mesh set (dist.auto_parallel.set_mesh) and "
+                "none passed — the config is IGNORED and the model stays "
+                "fully replicated (reference-documented no-op)")
+        return model, optimizer
+    if not (known & set(config)):
+        return model, optimizer
+    if "mp_config" in config:
+        tensor_parallel(model, config["mp_config"].get("parallelize_plan"),
+                        mesh)
+    if "pp_config" in config:
+        pp_cfg = config["pp_config"]
+        model = pipeline_parallel(model, optimizer, pp_cfg.get("split_spec"),
+                                  pp_cfg.get("global_spec"), mesh)
+    if "dp_config" in config:
+        model, optimizer = sharded_data_parallel(
+            model, optimizer, config["dp_config"].get("sharding_level", 0),
+            mesh)
+    return model, optimizer

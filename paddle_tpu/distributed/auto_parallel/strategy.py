@@ -1,0 +1,85 @@
+"""dist.Strategy — the auto-parallel config tree.
+
+Reference: python/paddle/distributed/auto_parallel/api.py:1973 (class Strategy)
+and auto_parallel/strategy.py. Config groups mirror the reference's names:
+`sharding`, `amp`, `pipeline`, `gradient_merge`, `fused_passes`. On TPU most
+fusion passes are XLA's job, so `fused_passes` is accepted for compatibility
+and recorded but has no effect (documented per field).
+"""
+from __future__ import annotations
+
+
+class _ConfigGroup:
+    _fields: dict = {}
+
+    def __init__(self, **kwargs):
+        for k, v in type(self)._fields.items():
+            setattr(self, k, kwargs.pop(k, v))
+        if kwargs:
+            raise ValueError(
+                f"unknown {type(self).__name__} options: {sorted(kwargs)}")
+
+    def to_dict(self):
+        return {k: getattr(self, k) for k in type(self)._fields}
+
+    def __repr__(self):
+        body = ", ".join(f"{k}={getattr(self, k)!r}" for k in type(self)._fields)
+        return f"{type(self).__name__}({body})"
+
+
+class ShardingConfig(_ConfigGroup):
+    """ZeRO config. stage in {0,1,2,3}; degree=-1 means the full dp axis."""
+
+    _fields = {"enable": False, "stage": 1, "degree": -1}
+
+
+class AMPConfig(_ConfigGroup):
+    """Mixed precision. level in {'o1','o2'}; dtype 'bfloat16' (TPU-native
+    default) or 'float16' (adds GradScaler loss scaling)."""
+
+    _fields = {
+        "enable": False, "dtype": "bfloat16", "level": "o2",
+        "init_loss_scaling": 32768.0, "use_master_grad": False,
+        "custom_black_list": (), "custom_white_list": (),
+    }
+
+
+class PipelineConfig(_ConfigGroup):
+    """Pipeline schedule config. schedule_mode in {'1F1B','FThenB','VPP'}."""
+
+    _fields = {
+        "enable": False, "schedule_mode": "1F1B", "micro_batch_size": 1,
+        "accumulate_steps": 1, "vpp_degree": 1,
+    }
+
+
+class GradientMergeConfig(_ConfigGroup):
+    _fields = {"enable": False, "k_steps": 1, "avg": True}
+
+
+class FusedPassesConfig(_ConfigGroup):
+    """Accepted for reference compatibility; XLA performs operator fusion on
+    TPU so the pass list is recorded but not interpreted."""
+
+    _fields = {"enable": False, "fused_passes_list": ()}
+
+
+class Strategy:
+    """Reference api.py:1973. Groups: sharding / amp / pipeline /
+    gradient_merge / fused_passes, each with `.enable` plus options."""
+
+    def __init__(self, config: dict | None = None):
+        config = dict(config or {})
+        self.sharding = ShardingConfig(**config.pop("sharding", {}))
+        self.amp = AMPConfig(**config.pop("amp", {}))
+        self.pipeline = PipelineConfig(**config.pop("pipeline", {}))
+        self.gradient_merge = GradientMergeConfig(
+            **config.pop("gradient_merge", {}))
+        self.fused_passes = FusedPassesConfig(**config.pop("fused_passes", {}))
+        if config:
+            raise ValueError(f"unknown Strategy groups: {sorted(config)}")
+
+    def __repr__(self):
+        return (f"Strategy(sharding={self.sharding}, amp={self.amp}, "
+                f"pipeline={self.pipeline}, "
+                f"gradient_merge={self.gradient_merge})")

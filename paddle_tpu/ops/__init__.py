@@ -157,11 +157,18 @@ def _cell_key(v, depth=0):
     if isinstance(v, (jax.Array, np.ndarray)):
         return None  # data in a closure: unsafe to key on
     if callable(v) and hasattr(v, "__code__") and depth < 2:
+        if getattr(v, "__self__", None) is not None:
+            return None  # bound method in a cell: instance state invisible
         inner = tuple(
             _cell_key(c.cell_contents, depth + 1) for c in (v.__closure__ or ())
         )
         if _builtins.any(c is None for c in inner):
             return None
+        if v.__defaults__:
+            dflt = tuple(_cell_key(d, depth + 1) for d in v.__defaults__)
+            if _builtins.any(d is None for d in dflt):
+                return None
+            inner = inner + (("__defaults__",) + dflt,)
         return (v.__code__, inner)
     try:
         hash(v)

@@ -1,0 +1,13 @@
+"""Shared model-zoo helpers. Reference: python/paddle/vision/models/_utils.py."""
+from __future__ import annotations
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    """Round `v` to the nearest multiple of `divisor`, never dropping more
+    than 10% (the MobileNet channel-rounding rule)."""
+    if min_value is None:
+        min_value = divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v

@@ -11,13 +11,7 @@ from ...ops.manipulation import flatten
 __all__ = ["MobileNetV2", "mobilenet_v2"]
 
 
-def _make_divisible(v, divisor=8, min_value=None):
-    if min_value is None:
-        min_value = divisor
-    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
-    if new_v < 0.9 * v:
-        new_v += divisor
-    return new_v
+from ._utils import _make_divisible  # noqa: E402
 
 
 class _ConvBNReLU(Sequential):

@@ -14,13 +14,7 @@ __all__ = ["MobileNetV3Small", "MobileNetV3Large", "mobilenet_v3_small",
            "mobilenet_v3_large"]
 
 
-def _make_divisible(v, divisor=8, min_value=None):
-    if min_value is None:
-        min_value = divisor
-    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
-    if new_v < 0.9 * v:
-        new_v += divisor
-    return new_v
+from ._utils import _make_divisible  # noqa: E402
 
 
 class SqueezeExcitation(Layer):

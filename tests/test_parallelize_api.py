@@ -1,0 +1,278 @@
+"""parallelize plan API + dist.to_static/DistModel/Strategy (VERDICT r3 #1).
+
+Done-bar: a PLAIN model (no fleet layers) is turned into TP / TP+PP+ZeRO by a
+plan dict alone, with loss parity against the single-device micro-batch
+accumulation loop on the 8-device CPU mesh.
+
+Reference: intermediate/parallelize.py:51, auto_parallel/api.py:2952 (to_static),
+:2254 (DistModel), :1973 (Strategy)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import nn
+
+HID = 16
+BATCH = 8
+MICRO = 4
+N_BLOCKS = 4
+
+
+class _PlainBlock(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.up = nn.Linear(HID, HID * 2)
+        self.down = nn.Linear(HID * 2, HID)
+
+    def forward(self, x):
+        return self.down(nn.functional.relu(self.up(x)))
+
+
+class _PlainModel(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.blocks = nn.LayerList([_PlainBlock() for _ in range(N_BLOCKS)])
+
+    def forward(self, x):
+        for b in self.blocks:
+            x = b(x)
+        return x
+
+
+def _loss_fn(out, label):
+    return ((out - label) ** 2).mean()
+
+
+def _data(step):
+    rs = np.random.RandomState(100 + step)
+    x = paddle.to_tensor(rs.randn(BATCH, HID).astype("float32"))
+    y = paddle.to_tensor(rs.randn(BATCH, HID).astype("float32"))
+    return x, y
+
+
+def _run_single(steps, micro=1):
+    dist.set_mesh(None)
+    paddle.seed(11)
+    model = _PlainModel()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    losses = []
+    for step in range(steps):
+        x, y = _data(step)
+        if micro > 1:
+            total = 0.0
+            for mx, my in zip(paddle.split(x, micro, axis=0),
+                              paddle.split(y, micro, axis=0)):
+                loss = _loss_fn(model(mx), my)
+                (loss / micro).backward()
+                total += float(loss)
+            losses.append(total / micro)
+        else:
+            loss = _loss_fn(model(x), y)
+            loss.backward()
+            losses.append(float(loss))
+        opt.step()
+        opt.clear_grad()
+    return losses
+
+
+MP_PLAN_KEYS = {
+    r"blocks\.\d+\.up": "col",
+    r"blocks\.\d+\.down": "row",
+}
+
+
+def _mp_plan():
+    return {
+        r"blocks\.\d+\.up": dist.ColWiseParallel(),
+        r"blocks\.\d+\.down": dist.RowWiseParallel(),
+    }
+
+
+def test_parallelize_tp_sharding_annotations():
+    dist.set_mesh(None)
+    mesh = dist.ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "mp"])
+    dist.auto_parallel.set_mesh(mesh)
+    paddle.seed(11)
+    model = _PlainModel()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    model, opt = dist.parallelize(
+        model, opt, config={"mp_config": {"parallelize_plan": _mp_plan()}})
+    for i in range(N_BLOCKS):
+        up_w = model.blocks[i].up.weight
+        down_w = model.blocks[i].down.weight
+        assert up_w._dist_attr is not None
+        _, pl = up_w._dist_attr
+        assert isinstance(pl[1], dist.Shard) and pl[1].dim == 1
+        _, pl = down_w._dist_attr
+        assert isinstance(pl[1], dist.Shard) and pl[1].dim == 0
+    dist.set_mesh(None)
+
+
+@pytest.mark.parametrize("sharding_level", [0, 2])
+def test_to_static_tp_dp_parity(sharding_level):
+    steps = 5
+    ref = _run_single(steps)
+    dist.set_mesh(None)
+    mesh = dist.ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "mp"])
+    dist.auto_parallel.set_mesh(mesh)
+    paddle.seed(11)
+    model = _PlainModel()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    cfg = {"mp_config": {"parallelize_plan": _mp_plan()}}
+    if sharding_level:
+        cfg["dp_config"] = {"sharding_level": sharding_level}
+    model, opt = dist.parallelize(model, opt, config=cfg)
+    dm = dist.to_static(model, loss=_loss_fn, optimizer=opt)
+    dm.train()
+    got = [float(dm(*_data(s)).numpy()) for s in range(steps)]
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    dist.set_mesh(None)
+
+
+def test_to_static_pp_tp_zero_parity():
+    """The headline: plain model -> TP+PP+ZeRO purely via the plan dict."""
+    steps = 6
+    ref = _run_single(steps, micro=MICRO)
+    dist.set_mesh(None)
+    mesh = dist.ProcessMesh(np.arange(8).reshape(2, 2, 2),
+                            ["pp", "dp", "mp"])
+    dist.auto_parallel.set_mesh(mesh)
+    paddle.seed(11)
+    model = _PlainModel()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    model, opt = dist.parallelize(model, opt, config={
+        "mp_config": {"parallelize_plan": _mp_plan()},
+        "pp_config": {"split_spec": "blocks"},
+        "dp_config": {"sharding_level": 2},
+    })
+    # chain entries are the atomic blocks: 4 blocks -> 2 stages of 2
+    assert model._pp_bounds == [0, N_BLOCKS // 2, N_BLOCKS]
+    strategy = dist.Strategy({"pipeline": {"enable": True,
+                                           "accumulate_steps": MICRO}})
+    dm = dist.to_static(model, loss=_loss_fn, optimizer=opt,
+                        strategy=strategy)
+    dm.train()
+    got = [float(dm(*_data(s)).numpy()) for s in range(steps)]
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    dist.set_mesh(None)
+
+
+def test_to_static_pp_split_spec_dict():
+    dist.set_mesh(None)
+    mesh = dist.ProcessMesh(np.arange(2).reshape(2), ["pp"])
+    dist.auto_parallel.set_mesh(mesh)
+    paddle.seed(11)
+    model = _PlainModel()
+    model, _ = dist.parallelize(model, None, config={
+        "pp_config": {"split_spec": {"blocks.1": dist.SplitPoint.END}}})
+    assert model._pp_bounds == [0, 2, 4]  # split after blocks.1
+    dist.set_mesh(None)
+
+
+def test_sequence_parallel_plan_smoke():
+    """SP hooks place activation constraints; training still runs + matches."""
+    steps = 3
+    ref = _run_single(steps)
+    dist.set_mesh(None)
+    mesh = dist.ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "mp"])
+    dist.auto_parallel.set_mesh(mesh)
+    paddle.seed(11)
+    model = _PlainModel()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    plan = _mp_plan()
+    plan["blocks.0"] = dist.SequenceParallelBegin()
+    plan[f"blocks.{N_BLOCKS - 1}"] = dist.SequenceParallelEnd()
+    model, opt = dist.parallelize(
+        model, opt, config={"mp_config": {"parallelize_plan": plan}})
+    dm = dist.to_static(model, loss=_loss_fn, optimizer=opt)
+    dm.train()
+    got = [float(dm(*_data(s)).numpy()) for s in range(steps)]
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    dist.set_mesh(None)
+
+
+def test_eval_and_predict_modes():
+    dist.set_mesh(None)
+    paddle.seed(11)
+    model = _PlainModel()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    dm = dist.to_static(model, loss=_loss_fn, optimizer=opt)
+    x, y = _data(0)
+    dm.train()
+    train_loss = float(dm(x, y).numpy())
+    dm.eval()
+    eval_loss = float(dm(x, y).numpy())
+    assert eval_loss < train_loss  # one step was taken
+    dm.predict()
+    out = dm(x)
+    assert list(out.shape) == [BATCH, HID]
+    sd = dm.state_dict()
+    assert any(k.endswith(".velocity") or ".velocity" in k or "." in k
+               for k in sd)
+
+
+def test_strategy_config_tree():
+    s = dist.Strategy({"sharding": {"enable": True, "stage": 2},
+                       "amp": {"enable": True, "dtype": "bfloat16"},
+                       "pipeline": {"enable": True, "schedule_mode": "1F1B",
+                                    "accumulate_steps": 4}})
+    assert s.sharding.stage == 2 and s.sharding.enable
+    assert s.amp.dtype == "bfloat16"
+    assert s.pipeline.accumulate_steps == 4
+    with pytest.raises(ValueError):
+        dist.Strategy({"bogus": {}})
+    with pytest.raises(ValueError):
+        dist.Strategy({"sharding": {"nope": 1}})
+
+
+def test_missing_exports_now_exist():
+    """The 11 paddle.distributed exports VERDICT r3 flagged as absent."""
+    for name in ["to_static", "DistModel", "Strategy", "parallelize",
+                 "ColWiseParallel", "RowWiseParallel",
+                 "SequenceParallelBegin", "SequenceParallelEnd",
+                 "SequenceParallelEnable", "SequenceParallelDisable",
+                 "PrepareLayerInput", "PrepareLayerOutput", "SplitPoint",
+                 "LocalLayer"]:
+        assert hasattr(dist, name), name
+    assert hasattr(dist.auto_parallel, "set_mesh")
+    assert hasattr(dist.auto_parallel, "get_mesh")
+
+
+def test_param_level_regex_plan_key():
+    """Regex layer path + .weight suffix must shard just that param (review
+    regression: trailing backslash crashed re.fullmatch)."""
+    dist.set_mesh(None)
+    mesh = dist.ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "mp"])
+    dist.auto_parallel.set_mesh(mesh)
+    paddle.seed(11)
+    model = _PlainModel()
+    model, _ = dist.parallelize(model, None, config={
+        "mp_config": {"parallelize_plan": {
+            r"blocks\.\d+\.up\.weight": dist.ColWiseParallel()}}})
+    w = model.blocks[0].up.weight
+    assert w._dist_attr is not None
+    _, pl = w._dist_attr
+    assert isinstance(pl[1], dist.Shard) and pl[1].dim == 1
+    assert model.blocks[0].up.bias._dist_attr is None  # only the weight
+    dist.set_mesh(None)
+
+
+def test_amp_path_no_bound_method_cache_collision():
+    """The cache guards must also hold under auto_cast, where apply_op wraps
+    fn in the AMP closure (review regression)."""
+    from paddle_tpu import distribution as D
+    import paddle_tpu.amp as amp
+
+    x = paddle.to_tensor(np.array([1.0], "float32"), stop_gradient=False)
+    with amp.auto_cast(enable=True, level="O2", dtype="float32"):
+        a = D.ChainTransform([D.ExpTransform()]).forward(x)
+        b = D.ChainTransform([D.TanhTransform()]).forward(x)
+    np.testing.assert_allclose(np.asarray(a._value), np.exp(1.0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(b._value), np.tanh(1.0), rtol=1e-5)
