@@ -128,9 +128,9 @@ class DistModel:
 
     def _ensure_engine(self):
         if self._engine is None:
-            from ..fleet.meta_parallel import PipelineLayer
-            from ..fleet.pipeline import PipelineEngine, StagePlacement, _Chunk
-            from jax.sharding import Mesh as JaxMesh
+            from ..fleet.pipeline import (
+                PipelineEngine, _Chunk, build_stage_placements,
+            )
 
             chain = self.network._pp_chain
             bounds = self.network._pp_bounds
@@ -151,31 +151,24 @@ class DistModel:
                         chain[chunk_bounds[c]:chunk_bounds[c + 1]]])
                 for c in range(len(chunk_bounds) - 1)
             ]
-            mesh = self._mesh
-            pp_idx = mesh.dim_names.index("pp")
-            grid = np.moveaxis(np.asarray(mesh.jax_mesh.devices), pp_idx, 0)
-            other_axes = tuple(n for i, n in enumerate(mesh.dim_names)
-                               if i != pp_idx)
             zero = 0
             sf = getattr(self._optimizer, "_shard_fn", None)
             if sf is not None:
                 zero = (3 if sf.shard_params else (2 if sf.shard_grads else 1))
-            stage_places = []
-            for i in range(grid.shape[0]):
-                sub = grid[i]
-                if sub.size == 1:
-                    stage_places.append(
-                        StagePlacement(device=sub.reshape(-1)[0]))
-                else:
-                    stage_places.append(StagePlacement(
-                        mesh=JaxMesh(sub, other_axes), zero_stage=zero))
+            stage_places = build_stage_placements(self._mesh, zero)
             placements = [stage_places[c % p] for c in range(len(chunks))]
-            self._engine = PipelineEngine(chunks, placements, self._loss)
+            self._engine = PipelineEngine(
+                chunks, placements, self._loss,
+                schedule=self._strategy.pipeline.schedule_mode)
         return self._engine
 
     def _pp_step(self, x, label):
         from ...ops.manipulation import split
 
+        if isinstance(x, (list, tuple)):
+            raise NotImplementedError(
+                "pipeline DistModel micro-batches a single input tensor; "
+                "multi-input pipeline models are not supported yet")
         engine = self._ensure_engine()
         n_micro = max(1, int(self._strategy.pipeline.accumulate_steps))
         xs = split(x, n_micro, axis=0) if n_micro > 1 else [x]

@@ -281,6 +281,7 @@ class PipelineParallel(Layer):
             raise TypeError("PipelineParallel requires a PipelineLayer model")
         self._layers = layers
         self._hcg = hcg
+        self._strategy = strategy
         cfg = (strategy.pipeline_configs if strategy else {}) or {}
         self.accumulate_steps = cfg.get("accumulate_steps", 1)
         self.micro_batch_size = cfg.get("micro_batch_size", 1)
@@ -299,29 +300,13 @@ class PipelineParallel(Layer):
         """One placement per physical stage. With a global mesh carrying a 'pp'
         axis plus dp/mp axes, each stage gets the SUB-MESH at its pp coordinate
         (hybrid PP×DP×TP×ZeRO composition); otherwise one device per stage."""
-        from jax.sharding import Mesh as JaxMesh
-
-        from .pipeline import StagePlacement
+        from .pipeline import StagePlacement, build_stage_placements
 
         devs = jax.devices()
         if self._hcg is not None and getattr(self._hcg, "mesh", None) is not None:
             mesh = self._hcg.mesh
             if "pp" in mesh.dim_names:
-                pp_idx = mesh.dim_names.index("pp")
-                grid = np.moveaxis(np.asarray(mesh.jax_mesh.devices), pp_idx, 0)
-                other_axes = tuple(n for i, n in enumerate(mesh.dim_names)
-                                   if i != pp_idx)
-                zero = self._zero_stage()
-                placements = []
-                for i in range(grid.shape[0]):
-                    sub = grid[i]
-                    if sub.size == 1:
-                        placements.append(StagePlacement(
-                            device=sub.reshape(-1)[0]))
-                    else:
-                        placements.append(StagePlacement(
-                            mesh=JaxMesh(sub, other_axes), zero_stage=zero))
-                return placements
+                return build_stage_placements(mesh, self._zero_stage())
         return [StagePlacement(device=devs[i % len(devs)])
                 for i in range(num_stages)]
 
@@ -350,7 +335,10 @@ class PipelineParallel(Layer):
         stage_places = self._stage_placements(p)
         # VPP placement: chunk c lives on stage c % p (reference :1308)
         placements = [stage_places[c % p] for c in range(n_chunks)]
-        self._engine = PipelineEngine(chunks, placements, self._layers.loss_fn)
+        cfg = (self._strategy.pipeline_configs if self._strategy else {}) or {}
+        schedule = cfg.get("schedule_mode", "1F1B")
+        self._engine = PipelineEngine(chunks, placements, self._layers.loss_fn,
+                                      schedule=schedule)
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         from ...ops.manipulation import split

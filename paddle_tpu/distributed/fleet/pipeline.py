@@ -183,6 +183,10 @@ class _StageExec:
         self._fwd = jax.jit(self._fwd_fn)
         self._bwd = jax.jit(self._bwd_fn)
         self._last = jax.jit(self._last_fn)
+        self._bwd_x = jax.jit(self._bwd_x_fn)
+        self._bwd_w = jax.jit(self._bwd_w_fn)
+        self._last_x = jax.jit(self._last_x_fn)
+        self._last_w = jax.jit(self._last_w_fn)
         self._state_cache = None  # (tr, fz) reused across micro-batches/steps
 
     # -- state handling ------------------------------------------------------
@@ -262,6 +266,53 @@ class _StageExec:
         (_, loss), (dtr, dx) = grad_fn(tr, x)
         return loss, self._constrain_grads(dtr), dx
 
+    # -- zero-bubble split backward (reference pipeline_zero_bubble.py:62):
+    # B computes ONLY the input gradient (the inter-stage critical path) and W
+    # computes ONLY the weight gradient, scheduled later to fill bubbles.
+    # Cost note: this engine's per-stage-remat design means B and W each
+    # recompute the stage forward (no residual sharing between the two jitted
+    # programs), so ZB-H1 here trades ~one extra forward per micro-batch per
+    # stage for the shorter B critical path — a win only when bubbles dominate
+    # (deep pipelines / few micro-batches). The schedule-shape parity with the
+    # reference is exact; residual-passing between B and W is future work.
+    def _bwd_x_fn(self, tr, fz, x, gy):
+        def f(x):
+            return self._fwd_fn(tr, fz, x)
+
+        _, vjp = jax.vjp(f, x)
+        (dx,) = vjp(gy)
+        return dx
+
+    def _bwd_w_fn(self, tr, fz, x, gy):
+        def f(tr):
+            return self._fwd_fn(tr, fz, x)
+
+        _, vjp = jax.vjp(f, tr)
+        (dtr,) = vjp(gy)
+        return self._constrain_grads(dtr)
+
+    def _last_x_fn(self, tr, fz, x, label, loss_scale):
+        def f(x):
+            out = self._call_chunk(tr, fz, x)
+            with tape.no_grad():
+                loss = self.loss_fn(out, Tensor(label))
+            lv = loss._value if isinstance(loss, Tensor) else loss
+            return lv * loss_scale, lv
+
+        (_, loss), dx = jax.value_and_grad(f, has_aux=True)(x)
+        return loss, dx
+
+    def _last_w_fn(self, tr, fz, x, label, loss_scale):
+        def f(tr):
+            out = self._call_chunk(tr, fz, x)
+            with tape.no_grad():
+                loss = self.loss_fn(out, Tensor(label))
+            lv = loss._value if isinstance(loss, Tensor) else loss
+            return lv * loss_scale
+
+        dtr = jax.grad(f)(tr)
+        return self._constrain_grads(dtr)
+
     # -- dispatch ------------------------------------------------------------
     def forward(self, tr, fz, x):
         return self._fwd(tr, fz, self.placement.put_act(x))
@@ -274,13 +325,32 @@ class _StageExec:
         return self._last(tr, fz, self.placement.put_act(x),
                           self.placement.put_act(label), loss_scale)
 
+    def backward_x(self, tr, fz, x, gy):
+        return self._bwd_x(tr, fz, self.placement.put_act(x),
+                           self.placement.put_act(gy))
 
-def _1f1b_instructions(num_stages: int, num_micro: int):
+    def backward_w(self, tr, fz, x, gy):
+        return self._bwd_w(tr, fz, self.placement.put_act(x),
+                           self.placement.put_act(gy))
+
+    def last_step_x(self, tr, fz, x, label, loss_scale):
+        return self._last_x(tr, fz, self.placement.put_act(x),
+                            self.placement.put_act(label), loss_scale)
+
+    def last_step_w(self, tr, fz, x, label, loss_scale):
+        return self._last_w(tr, fz, self.placement.put_act(x),
+                            self.placement.put_act(label), loss_scale)
+
+
+def _1f1b_instructions(num_stages: int, num_micro: int, warmup_extra: int = 0):
     """Per-stage 1F1B instruction streams (reference pipeline_parallel.py:684):
-    stage s runs min(p-1-s, m) warmup forwards, alternates 1F/1B, then drains."""
+    stage s runs min(p-1-s, m) warmup forwards, alternates 1F/1B, then drains.
+    `warmup_extra=1` gives Eager1F1B (reference pipeline_eager_1f1b pass): one
+    extra in-flight forward per stage so the activation send overlaps the next
+    forward instead of blocking on the backward."""
     streams = []
     for s in range(num_stages):
-        warmup = min(num_stages - 1 - s, num_micro)
+        warmup = min(num_stages - 1 - s + warmup_extra, num_micro)
         ops = [("F", i) for i in range(warmup)]
         f_i, b_i = warmup, 0
         while f_i < num_micro:
@@ -295,6 +365,91 @@ def _1f1b_instructions(num_stages: int, num_micro: int):
     return streams
 
 
+def _fthenb_instructions(num_stages: int, num_micro: int):
+    """FThenB (reference pipeline_scheduler_pass/pipeline_fthenb.py): every
+    stage runs all forwards, then all backwards. Highest activation memory,
+    simplest stream — the reference's default for small accumulate_steps."""
+    return [
+        [("F", i) for i in range(num_micro)]
+        + [("B", i) for i in range(num_micro)]
+        for _ in range(num_stages)
+    ]
+
+
+def _zb_h1_instructions(num_stages: int, num_micro: int):
+    """ZB-H1 zero-bubble streams (reference pipeline_zero_bubble.py:62).
+
+    The backward splits into B (input-grad — the only piece downstream stages
+    wait on) and W (weight-grad — off the critical path). Warmup and the F/B
+    steady state match 1F1B; W ops fill the cooldown bubbles and drain at the
+    end, so the inter-stage dependency chain carries only the cheap B ops."""
+    streams = []
+    for s in range(num_stages):
+        warmup = min(num_stages - 1 - s, num_micro)
+        ops = [("F", i) for i in range(warmup)]
+        f_i, b_i, w_i = warmup, 0, 0
+        while f_i < num_micro:
+            ops.append(("F", f_i))
+            ops.append(("B", b_i))
+            f_i += 1
+            b_i += 1
+        while b_i < num_micro:
+            ops.append(("B", b_i))
+            b_i += 1
+            # cooldown bubble: pull one deferred weight-grad forward
+            ops.append(("W", w_i))
+            w_i += 1
+        while w_i < num_micro:
+            ops.append(("W", w_i))
+            w_i += 1
+        streams.append(ops)
+    return streams
+
+
+#: schedule name -> (stream generator, uses split B/W backward)
+_SCHEDULES = {
+    "1F1B": (lambda p, m: _1f1b_instructions(p, m), False),
+    "Eager1F1B": (lambda p, m: _1f1b_instructions(p, m, warmup_extra=1), False),
+    "FThenB": (_fthenb_instructions, False),
+    "ZB-H1": (_zb_h1_instructions, True),
+}
+
+
+def _normalize_schedule(name: str) -> str:
+    key = str(name).replace("_", "").replace("-", "").lower()
+    for canon in _SCHEDULES:
+        if canon.replace("-", "").lower() == key:
+            return canon
+    if key in ("zbh1", "zerobubble", "zb"):
+        return "ZB-H1"
+    if key == "vpp":
+        # VPP interleaving lives in the CHUNKING (p*vpp chunks placed
+        # round-robin), not the stream generator — the streams stay 1F1B
+        return "1F1B"
+    raise ValueError(
+        f"unknown pipeline schedule {name!r}; choose from {list(_SCHEDULES)}")
+
+
+def build_stage_placements(mesh, zero_stage: int = 0):
+    """One StagePlacement per pp coordinate of `mesh` (a ProcessMesh with a
+    'pp' axis): single device, or the stage's sub-mesh over the other axes.
+    Shared by the fleet PipelineParallel wrapper and DistModel."""
+    import numpy as np
+
+    pp_idx = mesh.dim_names.index("pp")
+    grid = np.moveaxis(np.asarray(mesh.jax_mesh.devices), pp_idx, 0)
+    other_axes = tuple(n for i, n in enumerate(mesh.dim_names) if i != pp_idx)
+    placements = []
+    for i in range(grid.shape[0]):
+        sub = grid[i]
+        if sub.size == 1:
+            placements.append(StagePlacement(device=sub.reshape(-1)[0]))
+        else:
+            placements.append(StagePlacement(
+                mesh=Mesh(sub, other_axes), zero_stage=zero_stage))
+    return placements
+
+
 class PipelineEngine:
     """Executes a chunk chain over stage placements with per-stage 1F1B streams.
 
@@ -304,11 +459,12 @@ class PipelineEngine:
     chunks placed round-robin (chunk c on placement c % num_stages),
     reproducing the reference's VPP placement (pipeline_parallel.py:1308)."""
 
-    def __init__(self, chunks, placements, loss_fn):
+    def __init__(self, chunks, placements, loss_fn, schedule="1F1B"):
         self.execs = [
             _StageExec(c, placements[i], loss_fn if i == len(chunks) - 1 else None)
             for i, c in enumerate(chunks)
         ]
+        self.schedule = _normalize_schedule(schedule)
         placed: dict = {}
         for ex in self.execs:
             ex.place_params(placed)
@@ -318,7 +474,8 @@ class PipelineEngine:
         """One accumulation window. Returns (mean_loss, {id(param): grad})."""
         n_chunks = len(self.execs)
         m = len(micro_inputs)
-        streams = _1f1b_instructions(n_chunks, m)
+        gen, split_bw = _SCHEDULES[self.schedule]
+        streams = gen(n_chunks, m)
         cursors = [0] * n_chunks
         states = [ex.states() for ex in self.execs]
         acts_in: list[dict] = [dict() for _ in range(n_chunks)]   # stage -> mb -> x
@@ -336,6 +493,11 @@ class PipelineEngine:
                 return mb in acts_in[s]
             return mb in grads_in[s] and mb in acts_in[s]
 
+        def _accum(s, dtr):
+            acc_grads[s] = dtr if acc_grads[s] is None else jax.tree_util.tree_map(
+                jnp.add, acc_grads[s], dtr
+            )
+
         def execute(s, op, mb):
             ex = self.execs[s]
             tr, fz = states[s]
@@ -348,6 +510,28 @@ class PipelineEngine:
                 acts_in[s + 1][mb] = self.execs[s + 1].placement.put_act(y)
                 return
             x = acts_in[s][mb]
+            if op == "W":
+                # deferred weight-grad (zero-bubble): inputs kept alive by B
+                if s == n_chunks - 1:
+                    dtr = ex.last_step_w(tr, fz, x, micro_labels[mb],
+                                         loss_scale * inv_m)
+                else:
+                    dtr = ex.backward_w(tr, fz, x, grads_in[s][mb])
+                    del grads_in[s][mb]
+                del acts_in[s][mb]
+                _accum(s, dtr)
+                return
+            if split_bw:
+                # B: input-grad only — the inter-stage critical path
+                if s == n_chunks - 1:
+                    loss, dx = ex.last_step_x(tr, fz, x, micro_labels[mb],
+                                              loss_scale * inv_m)
+                    losses.append(loss)
+                else:
+                    dx = ex.backward_x(tr, fz, x, grads_in[s][mb])
+                if s > 0:
+                    grads_in[s - 1][mb] = self.execs[s - 1].placement.put_act(dx)
+                return  # x (and gy) stay for the W op
             if s == n_chunks - 1:
                 loss, dtr, dx = ex.last_step(tr, fz, x, micro_labels[mb],
                                              loss_scale * inv_m)
@@ -357,9 +541,7 @@ class PipelineEngine:
             del acts_in[s][mb]
             if s > 0:
                 grads_in[s - 1][mb] = self.execs[s - 1].placement.put_act(dx)
-            acc_grads[s] = dtr if acc_grads[s] is None else jax.tree_util.tree_map(
-                jnp.add, acc_grads[s], dtr
-            )
+            _accum(s, dtr)
 
         remaining = sum(len(st) for st in streams)
         while remaining:

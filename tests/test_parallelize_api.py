@@ -201,8 +201,8 @@ def test_eval_and_predict_modes():
     dist.set_mesh(None)
     paddle.seed(11)
     model = _PlainModel()
-    opt = paddle.optimizer.SGD(learning_rate=0.1,
-                               parameters=model.parameters())
+    opt = paddle.optimizer.AdamW(learning_rate=0.01,
+                                 parameters=model.parameters())
     dm = dist.to_static(model, loss=_loss_fn, optimizer=opt)
     x, y = _data(0)
     dm.train()
@@ -213,9 +213,15 @@ def test_eval_and_predict_modes():
     dm.predict()
     out = dm(x)
     assert list(out.shape) == [BATCH, HID]
+    # mode='all' must include real optimizer accumulators; 'opt' only them
     sd = dm.state_dict()
-    assert any(k.endswith(".velocity") or ".velocity" in k or "." in k
-               for k in sd)
+    assert any(k.endswith(".moment1") for k in sd)
+    opt_sd = dm.state_dict(mode="opt")
+    assert opt_sd and all(
+        k.rsplit(".", 1)[-1] in ("moment1", "moment2", "moment2_max",
+                                 "beta1_pow", "beta2_pow") for k in opt_sd)
+    model_sd = dm.state_dict(mode="model")
+    assert not any(k.endswith(".moment1") for k in model_sd)
 
 
 def test_strategy_config_tree():

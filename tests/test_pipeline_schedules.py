@@ -1,0 +1,200 @@
+"""Pipeline schedules beyond 1F1B: FThenB, Eager1F1B, zero-bubble ZB-H1.
+
+Reference: distributed/passes/pipeline_scheduler_pass/{pipeline_fthenb.py,
+pipeline_eager_1f1b.py, pipeline_zero_bubble.py:62 (ZB-H1)}. Stream-shape
+unit tests + loss parity through the engine on the 8-device CPU mesh
+(VERDICT r3 #5 done-bar)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import nn
+from paddle_tpu.distributed.fleet.pipeline import (
+    _1f1b_instructions, _fthenb_instructions, _normalize_schedule,
+    _zb_h1_instructions,
+)
+
+P, M = 4, 8
+
+
+# ------------------------------------------------------------------ streams
+def test_fthenb_stream_shape():
+    streams = _fthenb_instructions(P, M)
+    for ops in streams:
+        assert ops == ([("F", i) for i in range(M)]
+                       + [("B", i) for i in range(M)])
+
+
+def test_eager_1f1b_stream_shape():
+    eager = _1f1b_instructions(P, M, warmup_extra=1)
+    plain = _1f1b_instructions(P, M)
+    for s in range(P):
+        # leading run of F ops = warmup + the first steady-state F
+        def warmup(ops):
+            n = 0
+            for op, _ in ops:
+                if op != "F":
+                    break
+                n += 1
+            return n
+
+        assert warmup(eager[s]) == min(P - s + 1, M)
+        assert warmup(plain[s]) == min(P - s, M)
+        assert warmup(eager[s]) == warmup(plain[s]) + 1  # one extra in flight
+        # same op multiset: all M forwards and M backwards
+        for ops in (eager[s], plain[s]):
+            assert sorted(mb for op, mb in ops if op == "F") == list(range(M))
+            assert sorted(mb for op, mb in ops if op == "B") == list(range(M))
+
+
+def test_zb_h1_stream_shape():
+    streams = _zb_h1_instructions(P, M)
+    for s, ops in enumerate(streams):
+        fs = [mb for op, mb in ops if op == "F"]
+        bs = [mb for op, mb in ops if op == "B"]
+        ws = [mb for op, mb in ops if op == "W"]
+        assert fs == list(range(M)) and bs == list(range(M))
+        assert sorted(ws) == list(range(M))  # every microbatch gets a W
+        # every W_i comes after its B_i
+        for i in range(M):
+            assert ops.index(("W", i)) > ops.index(("B", i))
+        # warmup matches 1F1B (H1 keeps 1F1B's activation memory profile);
+        # the leading F run includes the first steady-state F
+        n = 0
+        for op, _ in ops:
+            if op != "F":
+                break
+            n += 1
+        assert n == min(P - s, M)
+    # last stage interleaves W into the cooldown: at least one W before the
+    # final B-drain completes on upstream stages
+    assert ("W", 0) in streams[-1]
+
+
+def test_schedule_name_normalization():
+    assert _normalize_schedule("1F1B") == "1F1B"
+    assert _normalize_schedule("fthenb") == "FThenB"
+    assert _normalize_schedule("FThenB") == "FThenB"
+    assert _normalize_schedule("eager_1f1b") == "Eager1F1B"
+    assert _normalize_schedule("ZB-H1") == "ZB-H1"
+    assert _normalize_schedule("zb_h1") == "ZB-H1"
+    assert _normalize_schedule("zero_bubble") == "ZB-H1"
+    with pytest.raises(ValueError):
+        _normalize_schedule("nope")
+
+
+# ------------------------------------------------------------------ parity
+HID = 16
+BATCH = 8
+MICRO = 4
+N_BLOCKS = 4
+
+
+class _Block(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.up = nn.Linear(HID, HID * 2)
+        self.down = nn.Linear(HID * 2, HID)
+
+    def forward(self, x):
+        return self.down(nn.functional.relu(self.up(x)))
+
+
+class _Model(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.blocks = nn.LayerList([_Block() for _ in range(N_BLOCKS)])
+
+    def forward(self, x):
+        for b in self.blocks:
+            x = b(x)
+        return x
+
+
+def _loss_fn(out, label):
+    return ((out - label) ** 2).mean()
+
+
+def _data(step):
+    rs = np.random.RandomState(100 + step)
+    return (paddle.to_tensor(rs.randn(BATCH, HID).astype("float32")),
+            paddle.to_tensor(rs.randn(BATCH, HID).astype("float32")))
+
+
+def _run_single(steps):
+    dist.set_mesh(None)
+    paddle.seed(11)
+    model = _Model()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    losses = []
+    for step in range(steps):
+        x, y = _data(step)
+        total = 0.0
+        for mx, my in zip(paddle.split(x, MICRO, axis=0),
+                          paddle.split(y, MICRO, axis=0)):
+            loss = _loss_fn(model(mx), my)
+            (loss / MICRO).backward()
+            total += float(loss)
+        opt.step()
+        opt.clear_grad()
+        losses.append(total / MICRO)
+    return losses
+
+
+@pytest.mark.parametrize("schedule", ["FThenB", "Eager1F1B", "ZB-H1"])
+def test_schedule_loss_parity(schedule):
+    steps = 5
+    ref = _run_single(steps)
+    dist.set_mesh(None)
+    mesh = dist.ProcessMesh(np.arange(8).reshape(2, 2, 2), ["pp", "dp", "mp"])
+    dist.auto_parallel.set_mesh(mesh)
+    paddle.seed(11)
+    model = _Model()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    model, opt = dist.parallelize(model, opt, config={
+        "mp_config": {"parallelize_plan": {
+            r"blocks\.\d+\.up": dist.ColWiseParallel(),
+            r"blocks\.\d+\.down": dist.RowWiseParallel(),
+        }},
+        "pp_config": {"split_spec": "blocks"},
+    })
+    dm = dist.to_static(
+        model, loss=_loss_fn, optimizer=opt,
+        strategy=dist.Strategy({"pipeline": {
+            "enable": True, "schedule_mode": schedule,
+            "accumulate_steps": MICRO}}))
+    dm.train()
+    got = [float(dm(*_data(s)).numpy()) for s in range(steps)]
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    assert dm._engine.schedule == schedule
+    dist.set_mesh(None)
+
+
+def test_fleet_wrapper_schedule_mode():
+    """schedule_mode threads through the fleet DistributedStrategy path too."""
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.distributed.fleet.base import HybridCommunicateGroup
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        LayerDesc, PipelineLayer, PipelineParallel,
+    )
+
+    dist.set_mesh(None)
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs.update(pp_degree=2, dp_degree=2, mp_degree=2)
+    strategy.pipeline_configs = {"accumulate_steps": MICRO,
+                                 "micro_batch_size": BATCH // MICRO,
+                                 "schedule_mode": "zero_bubble"}
+    hcg = HybridCommunicateGroup(strategy=strategy)
+    paddle.seed(11)
+    model = PipelineLayer([LayerDesc(_Block) for _ in range(N_BLOCKS)],
+                          num_stages=2, loss_fn=_loss_fn)
+    wrapper = PipelineParallel(model, hcg=hcg, strategy=strategy)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    loss = wrapper.train_batch(_data(0), opt)
+    assert wrapper._engine.schedule == "ZB-H1"
+    assert np.isfinite(float(loss))
+    dist.set_mesh(None)
