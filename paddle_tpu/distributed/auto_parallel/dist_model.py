@@ -16,7 +16,6 @@ TPU-native engine is far shorter because XLA owns those passes:
 from __future__ import annotations
 
 import jax
-import numpy as np
 
 from ...tensor import Tensor
 from ..mesh import get_mesh

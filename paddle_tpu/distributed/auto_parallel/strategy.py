@@ -45,7 +45,10 @@ class AMPConfig(_ConfigGroup):
 
 
 class PipelineConfig(_ConfigGroup):
-    """Pipeline schedule config. schedule_mode in {'1F1B','FThenB','VPP'}."""
+    """Pipeline schedule config. schedule_mode in {'1F1B', 'FThenB',
+    'Eager1F1B', 'ZB-H1'} (underscore/case-insensitive aliases accepted, e.g.
+    'zero_bubble'); 'VPP' interleaving comes from vpp_degree>1 (the streams
+    stay 1F1B over p*vpp round-robin chunks)."""
 
     _fields = {
         "enable": False, "schedule_mode": "1F1B", "micro_batch_size": 1,

@@ -1,14 +1,20 @@
-"""Data loading. Reference: python/paddle/io/ (Dataset/DataLoader/Sampler).
+"""Data loading. Reference: python/paddle/io/ (Dataset/DataLoader/Sampler,
+dataloader/worker.py for the multiprocess worker pool).
 
-Single-process-first design: on TPU the input pipeline runs on host CPU; workers are
-thread-based (the 1-process-per-host TPU model makes fork-based workers wasteful; the
-reference's shared-memory worker pool is a CUDA-era design)."""
+num_workers=0 runs inline; num_workers>0 forks a real worker-process pool
+(CPU-bound transforms scale across cores — the GIL makes threads useless for
+the vision pipeline). Workers never touch the accelerator: samples are
+collated to numpy in the worker, transported over pickle queues (fork gives
+copy-on-write sharing of the dataset itself), and wrapped into Tensors in the
+parent."""
 from __future__ import annotations
 
 import itertools
 import math
+import multiprocessing as mp
 import queue
 import threading
+import time as _time
 
 import numpy as np
 
@@ -249,18 +255,211 @@ class DistributedBatchSampler(BatchSampler):
 _worker_info = threading.local()
 
 
+class WorkerInfo:
+    """Reference: io/dataloader/worker.py (WorkerInfo). Available inside a
+    worker process via get_worker_info(): id / num_workers / dataset / seed."""
+
+    def __init__(self, id, num_workers, dataset, seed):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+        self.seed = seed
+
+    def __repr__(self):
+        return (f"WorkerInfo(id={self.id}, num_workers={self.num_workers}, "
+                f"seed={self.seed})")
+
+
 def get_worker_info():
     return getattr(_worker_info, "info", None)
+
+
+def _to_transportable(obj):
+    """Tensor -> numpy for the worker->parent queue (device arrays must not
+    cross the process boundary)."""
+    if isinstance(obj, Tensor):
+        return ("__tensor__", np.asarray(obj._value))
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_transportable(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _to_transportable(v) for k, v in obj.items()}
+    return obj
+
+
+def _from_transportable(obj):
+    if isinstance(obj, tuple) and len(obj) == 2 and obj[0] == "__tensor__":
+        return to_tensor(obj[1])
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_transportable(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _from_transportable(v) for k, v in obj.items()}
+    return obj
+
+
+def _map_worker_loop(dataset, index_queue, result_queue, collate_fn,
+                     worker_id, num_workers, seed, init_fn):
+    """Worker process body (map-style). Reference: dataloader/worker.py
+    (_worker_loop): install WorkerInfo, run init_fn, then serve
+    (batch_idx, indices) requests until the None sentinel."""
+    globals()["_worker_mode"] = True
+    _worker_info.info = WorkerInfo(worker_id, num_workers, dataset, seed)
+    np.random.seed(seed % (2 ** 31))
+    try:
+        if init_fn is not None:
+            init_fn(worker_id)
+        while True:
+            req = index_queue.get()
+            if req is None:
+                break
+            epoch, batch_idx, indices = req
+            try:
+                batch = collate_fn([dataset[i] for i in indices])
+                result_queue.put(
+                    (epoch, batch_idx, _to_transportable(batch), None))
+            except Exception as e:  # surface the traceback in the parent
+                import traceback
+
+                result_queue.put((epoch, batch_idx, None,
+                                  f"{e}\n{traceback.format_exc()}"))
+    except KeyboardInterrupt:
+        pass
+
+
+def _iterable_worker_loop(dataset, result_queue, collate_fn, batch_size,
+                          drop_last, worker_id, num_workers, seed, init_fn):
+    """Worker body (iterable-style): each worker iterates its own dataset
+    copy — the dataset splits work itself via get_worker_info() (reference
+    contract)."""
+    globals()["_worker_mode"] = True
+    _worker_info.info = WorkerInfo(worker_id, num_workers, dataset, seed)
+    np.random.seed(seed % (2 ** 31))
+    try:
+        if init_fn is not None:
+            init_fn(worker_id)
+        batch = []
+        for item in dataset:
+            batch.append(item)
+            if len(batch) == batch_size:
+                result_queue.put(
+                    (worker_id, _to_transportable(collate_fn(batch)), None))
+                batch = []
+        if batch and not drop_last:
+            result_queue.put(
+                (worker_id, _to_transportable(collate_fn(batch)), None))
+        result_queue.put((worker_id, None, None))  # this worker is done
+    except KeyboardInterrupt:
+        pass
+    except Exception as e:
+        import traceback
+
+        result_queue.put((worker_id, None, f"{e}\n{traceback.format_exc()}"))
+
+
+class _MapWorkerPool:
+    """Ordered multiprocess prefetch for map-style datasets: per-worker index
+    queues (batches assigned round-robin like the reference), one result
+    queue, and an in-parent reorder buffer so batches come back in sampler
+    order regardless of worker timing."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        ctx = mp.get_context("fork")
+        n = loader.num_workers
+        self.index_queues = [ctx.Queue() for _ in range(n)]
+        self.result_queue = ctx.Queue()
+        base_seed = int(np.random.randint(0, 2 ** 31))
+        self.workers = [
+            ctx.Process(
+                target=_map_worker_loop,
+                args=(loader.dataset, self.index_queues[i], self.result_queue,
+                      loader.collate_fn, i, n, base_seed + i,
+                      loader.worker_init_fn),
+                daemon=True)
+            for i in range(n)
+        ]
+        for w in self.workers:
+            w.start()
+
+    _epoch = 0
+
+    def run_epoch(self):
+        loader = self.loader
+        n = loader.num_workers
+        # epoch tag: results from an abandoned previous epoch (early break /
+        # peek with persistent_workers) still sit in the shared result queue —
+        # they must be discarded, not served as this epoch's batches
+        self._epoch += 1
+        epoch = self._epoch
+        batches = list(loader.batch_sampler)
+        depth = max(1, loader.prefetch_factor)
+        sent = 0
+        received = {}
+        next_out = 0
+
+        def dispatch():
+            nonlocal sent
+            if sent < len(batches):
+                self.index_queues[sent % n].put((epoch, sent, batches[sent]))
+                sent += 1
+
+        for _ in range(min(len(batches), depth * n)):
+            dispatch()
+        deadline = (None if not loader.timeout
+                    else _time.monotonic() + loader.timeout)
+        while next_out < len(batches):
+            while next_out not in received:
+                try:
+                    ep, bi, data, err = self.result_queue.get(timeout=5)
+                except queue.Empty:
+                    if deadline is not None and _time.monotonic() > deadline:
+                        raise RuntimeError(
+                            f"DataLoader worker timed out after "
+                            f"{loader.timeout}s")
+                    dead = [w.pid for w in self.workers if not w.is_alive()]
+                    if dead:
+                        raise RuntimeError(
+                            f"DataLoader worker(s) {dead} died unexpectedly "
+                            "(OOM-killed or crashed in a native transform)")
+                    continue
+                if err is not None:
+                    raise RuntimeError(f"DataLoader worker failed: {err}")
+                if ep != epoch:
+                    continue  # stale result from an abandoned epoch
+                received[bi] = data
+            data = received.pop(next_out)
+            next_out += 1
+            dispatch()
+            yield _from_transportable(data)
+
+    def shutdown(self):
+        for q in self.index_queues:
+            try:
+                q.put(None)
+            except Exception:
+                pass
+        for w in self.workers:
+            w.join(timeout=5)
+            if w.is_alive():
+                w.terminate()
+
+
+_worker_mode = False  # set inside worker processes: collate to numpy only
+# (forked children must not create jax arrays — fork with jax's thread pool
+# live can deadlock; the parent re-wraps via _from_transportable)
+
+
+def _collate_leaf(arr):
+    return ("__tensor__", arr) if _worker_mode else to_tensor(arr)
 
 
 def default_collate_fn(batch):
     sample = batch[0]
     if isinstance(sample, Tensor):
-        return to_tensor(np.stack([np.asarray(b._value) for b in batch]))
-    if isinstance(sample, np.ndarray):
-        return to_tensor(np.stack(batch))
+        return _collate_leaf(np.stack([np.asarray(b._value) for b in batch]))
+    if isinstance(sample, (np.ndarray, np.generic)):
+        return _collate_leaf(np.stack(batch))
     if isinstance(sample, (int, float)):
-        return to_tensor(np.asarray(batch))
+        return _collate_leaf(np.asarray(batch))
     if isinstance(sample, (list, tuple)):
         transposed = list(zip(*batch))
         return [default_collate_fn(list(s)) for s in transposed]
@@ -279,6 +478,10 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self.persistent_workers = persistent_workers
+        self._pool = None
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -309,6 +512,63 @@ class DataLoader:
             for idxs in self.batch_sampler:
                 yield self.collate_fn([self.dataset[i] for i in idxs])
 
+    def _iter_multiprocess_map(self):
+        pool = self._pool
+        if pool is None:
+            pool = _MapWorkerPool(self)
+            if self.persistent_workers:
+                self._pool = pool
+        try:
+            yield from pool.run_epoch()
+        finally:
+            if not self.persistent_workers:
+                pool.shutdown()
+
+    def _iter_multiprocess_iterable(self):
+        ctx = mp.get_context("fork")
+        # bounded: backpressure keeps host memory at ~n*prefetch_factor batches
+        result_queue = ctx.Queue(
+            maxsize=max(2, self.num_workers * max(1, self.prefetch_factor)))
+        n = self.num_workers
+        base_seed = int(np.random.randint(0, 2 ** 31))
+        workers = [
+            ctx.Process(
+                target=_iterable_worker_loop,
+                args=(self.dataset, result_queue, self.collate_fn,
+                      self.batch_size, self.drop_last, i, n, base_seed + i,
+                      self.worker_init_fn),
+                daemon=True)
+            for i in range(n)
+        ]
+        for w in workers:
+            w.start()
+        done = 0
+        deadline = None if not self.timeout else _time.monotonic() + self.timeout
+        try:
+            while done < n:
+                try:
+                    _, data, err = result_queue.get(timeout=5)
+                except queue.Empty:
+                    if deadline is not None and _time.monotonic() > deadline:
+                        raise RuntimeError(
+                            f"DataLoader worker timed out after {self.timeout}s")
+                    dead = [w.pid for w in workers if not w.is_alive()]
+                    if dead:
+                        raise RuntimeError(
+                            f"DataLoader worker(s) {dead} died unexpectedly")
+                    continue
+                if err is not None:
+                    raise RuntimeError(f"DataLoader worker failed: {err}")
+                if data is None:
+                    done += 1
+                    continue
+                yield _from_transportable(data)
+        finally:
+            for w in workers:
+                w.join(timeout=5)
+                if w.is_alive():
+                    w.terminate()
+
     def __iter__(self):
         # feed the profiler's throughput timer: time spent here (waiting on
         # data) is the step's reader_cost (reference timer.py reader hooks)
@@ -316,29 +576,17 @@ class DataLoader:
 
         bm = benchmark()
         if self.num_workers == 0:
-            for batch in self._iter_direct():
-                bm.after_reader()
-                yield batch
-                bm.before_reader()
-            return
-        # threaded prefetch pipeline (host-side IO overlap with device compute)
-        q: queue.Queue = queue.Queue(maxsize=self.num_workers * self.prefetch_factor)
-        sentinel = object()
-
-        def producer():
-            try:
-                for batch in self._iter_direct():
-                    q.put(batch)
-            finally:
-                q.put(sentinel)
-
-        t = threading.Thread(target=producer, daemon=True)
-        t.start()
-        while True:
-            item = q.get()
-            if item is sentinel:
-                break
+            src = self._iter_direct()
+        elif self._iterable_mode:
+            src = self._iter_multiprocess_iterable()
+        else:
+            src = self._iter_multiprocess_map()
+        for batch in src:
             bm.after_reader()
-            yield item
+            yield batch
             bm.before_reader()
-        t.join()
+
+    def __del__(self):
+        pool = getattr(self, "_pool", None)
+        if pool is not None:
+            pool.shutdown()

@@ -1,0 +1,150 @@
+"""Multiprocess DataLoader workers (VERDICT r3 #9).
+
+Reference: python/paddle/io/dataloader/worker.py — worker pool with ordered
+results, worker_init_fn, get_worker_info. Done-bar: a CPU-heavy transform
+pipeline shows near-linear speedup with num_workers."""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import io
+
+
+class _SlowDataset(io.Dataset):
+    """Simulates a CPU-bound transform (sleep is scheduler-fair, so the
+    speedup assertion is robust on loaded CI machines)."""
+
+    def __init__(self, n=64, delay=0.01):
+        self.n = n
+        self.delay = delay
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, idx):
+        time.sleep(self.delay)
+        return np.full((4,), idx, dtype="float32"), np.int64(idx)
+
+
+def _epoch_time(num_workers, **kw):
+    loader = io.DataLoader(_SlowDataset(), batch_size=8, shuffle=False,
+                           num_workers=num_workers, **kw)
+    t0 = time.monotonic()
+    batches = list(loader)
+    dt = time.monotonic() - t0
+    return dt, batches
+
+
+def test_worker_speedup_and_order():
+    serial, ref_batches = _epoch_time(0)
+    parallel, got_batches = _epoch_time(4)
+    # 64 samples x 10ms = 0.64s serial floor; 4 workers -> ~0.16s
+    assert parallel < serial / 2, (serial, parallel)
+    # ordered results: batches match the inline loader exactly
+    assert len(got_batches) == len(ref_batches)
+    for (gx, gy), (rx, ry) in zip(got_batches, ref_batches):
+        np.testing.assert_array_equal(np.asarray(gx._value),
+                                      np.asarray(rx._value))
+        np.testing.assert_array_equal(np.asarray(gy._value),
+                                      np.asarray(ry._value))
+
+
+class _InfoDataset(io.Dataset):
+    def __len__(self):
+        return 16
+
+    def __getitem__(self, idx):
+        info = io.get_worker_info()
+        assert info is not None and 0 <= info.id < info.num_workers
+        return np.int64(info.id)
+
+
+_INIT_CALLS = []
+
+
+def _init_fn(worker_id):
+    # runs IN the worker; communicate via an env-style side effect the parent
+    # can't see — instead stash onto the worker-local info for the dataset
+    info = io.get_worker_info()
+    assert info is not None and info.id == worker_id
+
+
+def test_worker_info_and_init_fn():
+    loader = io.DataLoader(_InfoDataset(), batch_size=4, num_workers=2,
+                           worker_init_fn=_init_fn)
+    ids = np.concatenate([np.asarray(b._value) for b in loader])
+    assert set(ids.tolist()) <= {0, 1}
+    assert io.get_worker_info() is None  # parent process has no worker info
+
+
+class _ShardedIterable(io.IterableDataset):
+    """Iterable dataset that self-shards via get_worker_info (reference
+    contract for IterableDataset + workers)."""
+
+    def __init__(self, n=32):
+        self.n = n
+
+    def __iter__(self):
+        info = io.get_worker_info()
+        if info is None:
+            lo, hi, step = 0, self.n, 1
+        else:
+            lo, hi, step = info.id, self.n, info.num_workers
+        for i in range(lo, hi, step):
+            yield np.full((2,), i, dtype="float32")
+
+
+def test_iterable_dataset_workers():
+    loader = io.DataLoader(_ShardedIterable(), batch_size=4, num_workers=2)
+    vals = sorted(
+        int(v) for b in loader for v in np.asarray(b._value)[:, 0])
+    assert vals == sorted(list(range(32)) * 1)
+
+
+def test_worker_exception_propagates():
+    class _Bad(io.Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, idx):
+            if idx == 5:
+                raise ValueError("boom-5")
+            return np.float32(idx)
+
+    loader = io.DataLoader(_Bad(), batch_size=2, num_workers=2)
+    with pytest.raises(RuntimeError, match="boom-5"):
+        list(loader)
+
+
+def test_persistent_workers_reused():
+    loader = io.DataLoader(_SlowDataset(n=16, delay=0.002), batch_size=4,
+                           num_workers=2, persistent_workers=True)
+    a = [np.asarray(b[0]._value) for b in loader]
+    pool = loader._pool
+    assert pool is not None
+    b = [np.asarray(x[0]._value) for x in loader]
+    assert loader._pool is pool  # same pool across epochs
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+    pool.shutdown()
+
+
+def test_persistent_pool_abandoned_epoch_no_stale_batches():
+    """Peeking one batch then re-iterating must not serve the previous
+    epoch's in-flight results (review regression: epoch tagging)."""
+    loader = io.DataLoader(_SlowDataset(n=32, delay=0.001), batch_size=4,
+                           num_workers=2, persistent_workers=True)
+    it = iter(loader)
+    first = next(it)  # abandon the rest of the epoch mid-flight
+    del it
+    full = [np.asarray(b[0]._value) for b in loader]
+    ref = [np.asarray(b[0]._value)
+           for b in io.DataLoader(_SlowDataset(n=32, delay=0.0),
+                                  batch_size=4, num_workers=0)]
+    assert len(full) == len(ref)
+    for x, y in zip(full, ref):
+        np.testing.assert_array_equal(x, y)
+    np.testing.assert_array_equal(np.asarray(first[0]._value), ref[0])
+    loader._pool.shutdown()
