@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Decode-path root-cause harness (VERDICT r3 #3): measures the single decode
+step and the in-scan step under different state dtypes / donation setups on
+the real chip, with cost-analysis bytes to separate HBM traffic from launch
+overhead."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+
+def sync(x):
+    return np.asarray(jax.device_get(x))
+
+
+def timeit(fn, *args, n=10, **kw):
+    out = fn(*args, **kw)
+    jax.tree_util.tree_map(
+        lambda a: a.block_until_ready() if hasattr(a, "block_until_ready")
+        else a, out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args, **kw)
+    jax.tree_util.tree_map(
+        lambda a: a.block_until_ready() if hasattr(a, "block_until_ready")
+        else a, out)
+    # force a real sync through the tunnel
+    leaves = jax.tree_util.tree_leaves(out)
+    if leaves:
+        sync(leaves[0].ravel()[0] if hasattr(leaves[0], "ravel") else leaves[0])
+    return (time.perf_counter() - t0) / n
+
+
+if __name__ == "__main__":
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTForCausalLM, GPTConfig
+    from paddle_tpu.tensor import Tensor as _T
+
+    B = int(os.environ.get("DBG_B", 1))
+    P, NEW = 128, 32
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
+                    num_heads=16, use_rope=True, use_rms_norm=True,
+                    use_swiglu=True, tie_embeddings=True)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    state = model.model_state_raw()
+    n_param_bytes = sum(v.nbytes for v in state.values())
+    print(f"params: {n_param_bytes/1e9:.2f} GB (f32)")
+
+    max_len = P + NEW
+    kv_h, hd = cfg.num_kv_heads, cfg.hidden_size // cfg.num_heads
+    ids = jnp.asarray(np.random.randint(0, 1000, (B, P)), jnp.int64)
+
+    def make_caches(dtype):
+        return [(jnp.zeros((B, max_len, kv_h, hd), dtype),
+                 jnp.zeros((B, max_len, kv_h, hd), dtype))
+                for _ in range(cfg.num_layers)]
+
+    def model_step(raw_state, tok_ids, caches, offset):
+        out = model.gpt.functional_call(
+            raw_state, _T(tok_ids),
+            caches=[(_T(k), _T(v)) for k, v in caches],
+            cache_offset=offset)
+        logits_t, new_caches = out
+        lg = logits_t._value
+        nc = [(kc._value, vc._value) for kc, vc in new_caches]
+        return lg[:, -1], nc
+
+    tok = jnp.asarray(np.random.randint(0, 1000, (B, 1)), jnp.int64)
+
+    # ---- A: standalone single decode step, f32 state
+    @jax.jit
+    def one_step(st, tok, caches):
+        lg, nc = model_step(st, tok, caches, jnp.int32(P))
+        return jnp.argmax(lg, -1), nc
+
+    caches = make_caches(jnp.float32)
+    low = one_step.lower(state, tok, caches)
+    ca = low.compile().cost_analysis()
+    print(f"A single step f32: {timeit(one_step, state, tok, caches)*1e3:.2f} ms"
+          f"  bytes={ca.get('bytes accessed', 0)/1e9:.2f}GB"
+          f"  flops={ca.get('flops', 0)/1e9:.2f}G")
+
+    # ---- B: same with bf16 state (cast OUTSIDE the program)
+    state_bf16 = {k: (v.astype(jnp.bfloat16)
+                      if v.dtype == jnp.float32 else v)
+                  for k, v in state.items()}
+    caches_bf = make_caches(jnp.bfloat16)
+    low = one_step.lower(state_bf16, tok, caches_bf)
+    ca = low.compile().cost_analysis()
+    print(f"B single step bf16: {timeit(one_step, state_bf16, tok, caches_bf)*1e3:.2f} ms"
+          f"  bytes={ca.get('bytes accessed', 0)/1e9:.2f}GB"
+          f"  flops={ca.get('flops', 0)/1e9:.2f}G")
+
+    # ---- C: scan of NEW steps, f32
+    def make_scan(donate):
+        @jax.jit
+        def scan_steps(st, tok0, caches):
+            def body(carry, t):
+                tok, caches = carry
+                lg, caches = model_step(st, tok[:, None], caches,
+                                        (P + t).astype(jnp.int32))
+                nxt = jnp.argmax(lg, -1).astype(tok.dtype)
+                return (nxt, caches), nxt
+
+            (_, _), toks = jax.lax.scan(
+                body, (tok0[:, 0], caches), jnp.arange(NEW))
+            return toks
+
+        return scan_steps
+
+    scan_f32 = make_scan(False)
+    caches = make_caches(jnp.float32)
+    low = scan_f32.lower(state, tok, caches)
+    ca = low.compile().cost_analysis()
+    dt = timeit(scan_f32, state, tok, caches, n=3)
+    print(f"C scan f32: {dt/NEW*1e3:.2f} ms/tok ({B*NEW/dt:.1f} tok/s)"
+          f"  bytes/tok={ca.get('bytes accessed', 0)/NEW/1e9:.2f}GB")
+
+    # ---- D: scan with bf16 state
+    scan_bf = make_scan(False)
+    caches_bf = make_caches(jnp.bfloat16)
+    low = scan_bf.lower(state_bf16, tok, caches_bf)
+    ca = low.compile().cost_analysis()
+    dt = timeit(scan_bf, state_bf16, tok, caches_bf, n=3)
+    print(f"D scan bf16: {dt/NEW*1e3:.2f} ms/tok ({B*NEW/dt:.1f} tok/s)"
+          f"  bytes/tok={ca.get('bytes accessed', 0)/NEW/1e9:.2f}GB")

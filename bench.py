@@ -128,6 +128,43 @@ def bench_gpt(on_accel, dev):
     return result, None
 
 
+def bench_serving(on_accel, dev):
+    """GPT-350M decode throughput (serving path): greedy generate with bf16
+    weight streaming, prompt 128 -> 128 new tokens, B=1 and B=8."""
+    import time
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    if on_accel:
+        cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
+                        num_heads=16, max_position=1024, use_rope=True,
+                        use_rms_norm=True, use_swiglu=True)
+        P, NEW = 128, 128
+    else:
+        cfg = GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                        num_heads=4, max_position=256)
+        P, NEW = 16, 16
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    out = {}
+    for B in (1, 8):
+        ids = paddle.to_tensor(
+            np.random.randint(0, cfg.vocab_size, (B, P)).astype(np.int64))
+        r = model.generate(ids, max_new_tokens=NEW)  # compile
+        np.asarray(r._value[0, -1:])  # hard sync through the tunnel
+        reps = 3 if on_accel else 1
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            r = model.generate(ids, max_new_tokens=NEW)
+        np.asarray(r._value[:, -1])
+        dt = (time.perf_counter() - t0) / reps
+        out[f"b{B}_tokens_per_sec"] = round(B * NEW / dt, 1)
+    out.update(prompt=P, new_tokens=NEW, decode_dtype="bfloat16")
+    return out, None
+
+
 def bench_resnet(on_accel, dev):
     import paddle_tpu as paddle
     from paddle_tpu import nn
@@ -203,6 +240,15 @@ def main():
     except Exception:
         pass
     try:
+        serving, serving_err = bench_serving(on_accel, dev)
+    except Exception as e:
+        serving, serving_err = None, {"error": repr(e)[:200]}
+    gc.collect()
+    try:
+        jax.clear_caches()
+    except Exception:
+        pass
+    try:
         resnet, resnet_err = bench_resnet(on_accel, dev)
     except Exception as e:  # resnet must not sink the GPT headline
         resnet, resnet_err = None, {"error": repr(e)[:200]}
@@ -217,6 +263,7 @@ def main():
             "mfu": gpt["mfu"],
             "audit": gpt["audit"],
             "gpt": gpt,
+            "serving": serving if serving is not None else serving_err,
             "resnet50": resnet if resnet is not None else resnet_err,
             "device": getattr(dev, "device_kind", dev.platform),
         }

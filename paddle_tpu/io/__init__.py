@@ -381,8 +381,23 @@ class _MapWorkerPool:
             w.start()
 
     _epoch = 0
+    _active = False
 
     def run_epoch(self):
+        loader = self.loader
+        if self._active:
+            raise RuntimeError(
+                "a persistent_workers DataLoader supports one live iterator "
+                "at a time (two iterators would consume each other's "
+                "batches); exhaust or drop the first iterator before "
+                "starting another")
+        self._active = True
+        try:
+            yield from self._run_epoch_inner()
+        finally:
+            self._active = False
+
+    def _run_epoch_inner(self):
         loader = self.loader
         n = loader.num_workers
         # epoch tag: results from an abandoned previous epoch (early break /
@@ -404,17 +419,19 @@ class _MapWorkerPool:
 
         for _ in range(min(len(batches), depth * n)):
             dispatch()
-        deadline = (None if not loader.timeout
-                    else _time.monotonic() + loader.timeout)
+        # timeout semantics match the reference: seconds WITHOUT progress
+        # (per-batch wait), not a whole-epoch budget
+        last_progress = _time.monotonic()
         while next_out < len(batches):
             while next_out not in received:
                 try:
                     ep, bi, data, err = self.result_queue.get(timeout=5)
                 except queue.Empty:
-                    if deadline is not None and _time.monotonic() > deadline:
+                    if (loader.timeout and
+                            _time.monotonic() - last_progress > loader.timeout):
                         raise RuntimeError(
                             f"DataLoader worker timed out after "
-                            f"{loader.timeout}s")
+                            f"{loader.timeout}s without a batch")
                     dead = [w.pid for w in self.workers if not w.is_alive()]
                     if dead:
                         raise RuntimeError(
@@ -426,6 +443,7 @@ class _MapWorkerPool:
                 if ep != epoch:
                     continue  # stale result from an abandoned epoch
                 received[bi] = data
+                last_progress = _time.monotonic()
             data = received.pop(next_out)
             next_out += 1
             dispatch()
@@ -543,15 +561,17 @@ class DataLoader:
         for w in workers:
             w.start()
         done = 0
-        deadline = None if not self.timeout else _time.monotonic() + self.timeout
+        last_progress = _time.monotonic()
         try:
             while done < n:
                 try:
                     _, data, err = result_queue.get(timeout=5)
                 except queue.Empty:
-                    if deadline is not None and _time.monotonic() > deadline:
+                    if (self.timeout and
+                            _time.monotonic() - last_progress > self.timeout):
                         raise RuntimeError(
-                            f"DataLoader worker timed out after {self.timeout}s")
+                            f"DataLoader worker timed out after "
+                            f"{self.timeout}s without a batch")
                     dead = [w.pid for w in workers if not w.is_alive()]
                     if dead:
                         raise RuntimeError(
@@ -559,6 +579,7 @@ class DataLoader:
                     continue
                 if err is not None:
                     raise RuntimeError(f"DataLoader worker failed: {err}")
+                last_progress = _time.monotonic()
                 if data is None:
                     done += 1
                     continue

@@ -148,3 +148,16 @@ def test_persistent_pool_abandoned_epoch_no_stale_batches():
         np.testing.assert_array_equal(x, y)
     np.testing.assert_array_equal(np.asarray(first[0]._value), ref[0])
     loader._pool.shutdown()
+
+
+def test_concurrent_iterators_raise_clearly():
+    """Two live iterators over one persistent pool would consume each other's
+    batches — must raise, not hang (review regression)."""
+    loader = io.DataLoader(_SlowDataset(n=16, delay=0.001), batch_size=4,
+                           num_workers=2, persistent_workers=True)
+    it1 = iter(loader)
+    next(it1)
+    with pytest.raises(RuntimeError, match="one live iterator"):
+        next(iter(loader))
+    del it1
+    loader._pool.shutdown()

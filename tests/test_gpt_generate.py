@@ -42,10 +42,17 @@ def test_cached_decode_matches_cachefree_greedy(kwargs):
     rng = np.random.default_rng(0)
     prompt = rng.integers(0, 128, (2, 5)).astype("int64")
     n_new = 6
+    # dtype=None keeps the params' own f32 -> token-exact vs the cache-free
+    # path; the default bf16 serving dtype trades exactness for ~6x decode
+    # throughput (weight streaming) and is exercised separately below
     got = np.asarray(m.generate(paddle.to_tensor(prompt),
-                                max_new_tokens=n_new)._value)
+                                max_new_tokens=n_new, dtype=None)._value)
     want = _greedy_reference(m, prompt, n_new)
     np.testing.assert_array_equal(got, want)
+    bf16 = np.asarray(m.generate(paddle.to_tensor(prompt),
+                                 max_new_tokens=n_new)._value)
+    assert bf16.shape == got.shape
+    np.testing.assert_array_equal(bf16[:, :prompt.shape[1]], prompt)
 
 
 def test_generate_shapes_and_determinism():
