@@ -1,8 +1,7 @@
 #!/usr/bin/env python
 """Decode-path root-cause harness (VERDICT r3 #3): measures the single decode
-step and the in-scan step under different state dtypes / donation setups on
-the real chip, with cost-analysis bytes to separate HBM traffic from launch
-overhead."""
+step and the in-scan step under different state dtypes on the real chip,
+with cost-analysis bytes to separate HBM traffic from launch overhead."""
 import os
 import sys
 import time
@@ -100,7 +99,7 @@ if __name__ == "__main__":
           f"  flops={ca.get('flops', 0)/1e9:.2f}G")
 
     # ---- C: scan of NEW steps, f32
-    def make_scan(donate):
+    def make_scan():
         @jax.jit
         def scan_steps(st, tok0, caches):
             def body(carry, t):
@@ -116,7 +115,7 @@ if __name__ == "__main__":
 
         return scan_steps
 
-    scan_f32 = make_scan(False)
+    scan_f32 = make_scan()
     caches = make_caches(jnp.float32)
     low = scan_f32.lower(state, tok, caches)
     ca = low.compile().cost_analysis()
@@ -125,7 +124,7 @@ if __name__ == "__main__":
           f"  bytes/tok={ca.get('bytes accessed', 0)/NEW/1e9:.2f}GB")
 
     # ---- D: scan with bf16 state
-    scan_bf = make_scan(False)
+    scan_bf = make_scan()
     caches_bf = make_caches(jnp.bfloat16)
     low = scan_bf.lower(state_bf16, tok, caches_bf)
     ca = low.compile().cost_analysis()

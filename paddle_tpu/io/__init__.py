@@ -384,7 +384,6 @@ class _MapWorkerPool:
     _active = False
 
     def run_epoch(self):
-        loader = self.loader
         if self._active:
             raise RuntimeError(
                 "a persistent_workers DataLoader supports one live iterator "
@@ -438,10 +437,10 @@ class _MapWorkerPool:
                             f"DataLoader worker(s) {dead} died unexpectedly "
                             "(OOM-killed or crashed in a native transform)")
                     continue
+                if ep != epoch:
+                    continue  # stale result/error from an abandoned epoch
                 if err is not None:
                     raise RuntimeError(f"DataLoader worker failed: {err}")
-                if ep != epoch:
-                    continue  # stale result from an abandoned epoch
                 received[bi] = data
                 last_progress = _time.monotonic()
             data = received.pop(next_out)

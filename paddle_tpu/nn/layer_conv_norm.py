@@ -153,6 +153,18 @@ class _BatchNormBase(Layer):
             data_format=self._data_format, use_global_stats=self._use_global_stats,
         )
 
+    def forward_fused(self, x, residual=None, act=None):
+        """BN + optional residual add + relu as one custom op (reference
+        fused_bn_add_activation role); numerically identical to
+        relu(bn(x) + residual) but the backward recomputes the epilogue
+        instead of saving intermediates (conv-net HBM lever)."""
+        return F.batch_norm(
+            x, self._mean, self._variance, self.weight, self.bias,
+            training=self.training, momentum=self._momentum, epsilon=self._epsilon,
+            data_format=self._data_format, use_global_stats=self._use_global_stats,
+            residual=residual, act=act,
+        )
+
 
 class BatchNorm(_BatchNormBase):
     """Legacy paddle.nn.BatchNorm (act arg)."""
