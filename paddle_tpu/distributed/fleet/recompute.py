@@ -14,9 +14,14 @@ from ...tensor import Tensor
 
 def recompute(function, *args, **kwargs):
     """Run `function(*args)` under rematerialization. Under the tape, we wrap the whole
-    call as one node whose vjp re-runs the forward (jax.checkpoint semantics)."""
+    call as one node whose vjp re-runs the forward (jax.checkpoint semantics).
+
+    `policy`: optional jax.checkpoint_policies entry (e.g. checkpoint_dots) —
+    save matmul outputs and recompute only the cheap elementwise ops, the
+    standard LLM selective-remat recipe."""
     use_reentrant = kwargs.pop("use_reentrant", True)
     preserve_rng_state = kwargs.pop("preserve_rng_state", True)
+    policy = kwargs.pop("policy", None)
 
     tensor_args = [a for a in args if isinstance(a, Tensor)]
 
@@ -35,7 +40,7 @@ def recompute(function, *args, **kwargs):
             return tuple(o._value if isinstance(o, Tensor) else o for o in out)
         return out._value if isinstance(out, Tensor) else out
 
-    ckpt_fn = jax.checkpoint(raw_fn)
+    ckpt_fn = jax.checkpoint(raw_fn, policy=policy)
     return apply_op(ckpt_fn, "recompute", *tensor_args)
 
 

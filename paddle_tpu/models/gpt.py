@@ -34,7 +34,7 @@ class GPTConfig:
     def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12, num_heads=12,
                  num_kv_heads=None, intermediate_size=None, max_position=2048,
                  dropout=0.0, use_rope=True, use_rms_norm=True, use_swiglu=True,
-                 tie_embeddings=True, dtype="float32"):
+                 tie_embeddings=True, dtype="float32", recompute=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -48,6 +48,13 @@ class GPTConfig:
         self.use_swiglu = use_swiglu
         self.tie_embeddings = tie_embeddings
         self.dtype = dtype
+        # None | "block" (save only block inputs) | "dots" (selective: save
+        # matmul outputs, recompute elementwise — LLM remat recipe that
+        # replaces XLA's unpredictable panic-remat under memory pressure)
+        if recompute not in (None, "block", "dots"):
+            raise ValueError(
+                f"recompute must be None, 'block' or 'dots', got {recompute!r}")
+        self.recompute = recompute
 
 
 def _shard_seq(x):
@@ -230,8 +237,17 @@ class GPTModel(Layer):
                 new_caches.append(new_kv)
         else:
             x = _shard_seq(x)
-            for blk in self.blocks:
-                x = blk(x, position_ids)
+            remat = self.config.recompute if self.training else None
+            if remat:
+                from ..distributed.fleet.recompute import recompute as _rc
+
+                policy = (jax.checkpoint_policies.checkpoint_dots
+                          if remat == "dots" else None)
+                for blk in self.blocks:
+                    x = _rc(blk, x, position_ids, policy=policy)
+            else:
+                for blk in self.blocks:
+                    x = blk(x, position_ids)
         x = self.ln_f(x)
         if self.config.tie_embeddings:
             logits = apply_op(lambda h, w: h @ w.T, "lm_head_tied", x,

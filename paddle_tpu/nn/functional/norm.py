@@ -84,21 +84,22 @@ def _bn_train_fwd(x, w, b, residual, ax, bshape, epsilon, act):
     # materialized for the next layer, so saving it adds no HBM traffic
     # (recomputing the pre-activation would re-read x AND residual)
     act_out = out if act == "relu" else None
-    has_res = residual is not None
-    return (out, mean, var), (x, w, b, act_out, has_res, mean, inv)
+    # the residual array rides along ONLY for its dtype (metadata access,
+    # no HBM read in the backward); a bare dtype is not a valid jax residual
+    return (out, mean, var), (x, w, b, act_out, residual, mean, inv)
 
 
 def _bn_train_bwd(ax, bshape, epsilon, act, res, cts):
     # cotangents on the mean/var outputs are dropped: they feed only the
     # no-grad running-statistics update
-    x, w, b, act_out, has_res, mean, inv = res
+    x, w, b, act_out, residual, mean, inv = res
     dy = cts[0]
     x32 = x.astype(jnp.float32)
     dy32 = dy.astype(jnp.float32)
     xhat = (x32 - mean.reshape(bshape)) * inv.reshape(bshape)
     if act == "relu":
         dy32 = jnp.where(act_out > 0, dy32, 0.0)
-    dres = dy32.astype(x.dtype) if has_res else None
+    dres = dy32.astype(residual.dtype) if residual is not None else None
     n = _bn_reduce_count(x.shape, ax)
     sum_dy = jnp.sum(dy32, axis=ax)
     sum_dy_xhat = jnp.sum(dy32 * xhat, axis=ax)

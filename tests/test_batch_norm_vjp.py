@@ -1,6 +1,7 @@
 """Custom-vjp training batch norm (_bn_train): gradient parity against the
-composed relu(bn(x)+residual) reference + the shifted one-pass variance
-stability case (review regressions)."""
+composed relu(bn(x)+residual) reference + variance numerical stability for
+large-mean inputs (guards the exact two-pass form; the one-pass and
+shifted variants were rejected — see docs/PERF.md)."""
 import numpy as np
 import pytest
 
@@ -62,8 +63,9 @@ def test_bn_train_vjp_matches_composed(with_residual, act):
 
 
 def test_bn_large_mean_no_cancellation():
-    """E[x^2]-E[x]^2 catastrophically cancels for |mean| >> std; the shifted
-    one-pass form must not (review regression: output std was 2.56, var 0)."""
+    """E[x^2]-E[x]^2 catastrophically cancels for |mean| >> std; the exact
+    two-pass variance must not (review regression: output std was 2.56,
+    running var clamped to 0)."""
     bn = nn.BatchNorm2D(3)
     bn.train()
     rs = np.random.RandomState(0)
@@ -82,3 +84,26 @@ def test_bn_act_validation():
     x = paddle.to_tensor(np.ones((2, 3, 4, 4), "float32"))
     with pytest.raises(ValueError, match="act"):
         bn.forward_fused(x, act="relu6")
+
+
+def test_bn_residual_grad_dtype_preserved():
+    """An f32 residual on a bf16 input must get an f32 gradient back
+    (review regression: cotangent was cast to x.dtype)."""
+    bn = nn.BatchNorm2D(3)
+    bn.train()
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(2, 3, 4, 4).astype("bfloat16"),
+                         stop_gradient=False)
+    res = paddle.to_tensor(rs.randn(2, 3, 4, 4).astype("float32"),
+                           stop_gradient=False)
+    out = bn.forward_fused(x, residual=res, act="relu")
+    paddle.sum(paddle.cast(out, "float32")).backward()
+    assert str(res.grad.dtype) in ("float32", "paddle.float32")
+    assert str(x.grad.dtype) in ("bfloat16", "paddle.bfloat16")
+
+
+def test_gpt_recompute_validation():
+    from paddle_tpu.models.gpt import GPTConfig
+
+    with pytest.raises(ValueError, match="recompute"):
+        GPTConfig(recompute="dot")

@@ -88,3 +88,30 @@ def test_generate_eos_stops_early():
     gen = out[0, 2:]
     assert gen[0] == first
     assert np.all(gen == first), "positions after eos must stay frozen to eos"
+
+
+def test_recompute_dots_loss_parity():
+    """cfg.recompute='dots' (selective remat) must not change the loss."""
+    import paddle_tpu as paddle
+    from paddle_tpu.jit.train import TrainStep
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 128, (2, 16)).astype("int64")
+    losses = {}
+    for remat in (None, "dots", "block"):
+        paddle.seed(7)
+        cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                        num_heads=4, max_position=64, recompute=remat)
+        m = GPTForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=m.parameters())
+        step = TrainStep(m, lambda logits, loss: loss, opt)
+        x = paddle.to_tensor(ids)
+        y = paddle.to_tensor(np.roll(ids, -1, axis=1))
+        l1 = float(step(x, labels=y).numpy())
+        l2 = float(step(x, labels=y).numpy())
+        losses[remat] = (l1, l2)
+    for remat in ("dots", "block"):
+        np.testing.assert_allclose(losses[remat], losses[None],
+                                   rtol=1e-5, atol=1e-6)
