@@ -16,6 +16,7 @@ from .fleet_api import (
     distributed_optimizer,
     get_hybrid_communicate_group,
 )
+from . import elastic  # noqa: F401
 from . import meta_parallel  # noqa: F401
 from .meta_parallel import (  # noqa: F401
     ColumnParallelLinear, PipelineLayer, RowParallelLinear, TensorParallel,

@@ -1,0 +1,16 @@
+"""Elastic training: TTL node liveness, scale in/out decisions, rank
+re-assignment, and preemption autocheckpoint.
+
+Reference: python/paddle/distributed/fleet/elastic/manager.py:125
+(ElasticManager over etcd: TTL node registry, np "min:max" scaling, fault
+levels at :177-186, special exit codes at :33-34). TPU-native mapping: the
+TCP store replaces etcd (timestamps + staleness replace leases), preemption
+arrives as SIGTERM (pod eviction) and triggers an immediate distributed
+checkpoint; the launch controller treats ELASTIC_EXIT_CODE restarts as
+free (they do not consume the crash-restart budget).
+"""
+from .manager import (  # noqa: F401
+    ELASTIC_AUTO_PARALLEL_EXIT_CODE, ELASTIC_EXIT_CODE, ElasticManager,
+    ElasticStatus,
+)
+from .checkpoint import AutoCheckpointer  # noqa: F401

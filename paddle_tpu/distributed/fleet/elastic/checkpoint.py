@@ -1,0 +1,118 @@
+"""Preemption-aware autocheckpoint.
+
+Reference: the PS-era auto_checkpoint (base/incubate/checkpoint/
+auto_checkpoint.py — etcd-coordinated epoch snapshots) + SURVEY §5's TPU
+prescription: pod preemption lands as SIGTERM; the worker must save and exit
+with ELASTIC_EXIT_CODE so the controller restarts it for free, and training
+resumes from the auto-saved step with loss continuity."""
+from __future__ import annotations
+
+import os
+import signal
+import tempfile
+
+from .manager import ELASTIC_EXIT_CODE
+
+
+class AutoCheckpointer:
+    """Periodic + on-preemption checkpointing for (model, optimizer, step).
+
+    Usage::
+
+        ckpt = AutoCheckpointer(model, opt, path, save_every=50)
+        start = ckpt.resume()                       # 0 on a fresh start
+        for step in range(start, total):
+            loss = train_step(...)
+            ckpt.step(step)                         # save point + preemption check
+
+    SIGTERM (preemption) sets a flag; the NEXT `step()` call saves and exits
+    with ELASTIC_EXIT_CODE (the handler itself must not serialize state
+    mid-update). Only rank 0 writes (replicated single-host params); the save
+    is atomic (tmp file + rename) so a kill during save never corrupts the
+    latest checkpoint."""
+
+    def __init__(self, model, optimizer=None, path="./auto_checkpoint",
+                 save_every=0, rank=None, install_signal_handler=True):
+        self.model = model
+        self.optimizer = optimizer
+        self.path = path
+        self.save_every = int(save_every)
+        if rank is None:
+            rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self.rank = rank
+        self.preempted = False
+        self._prev_handler = None
+        if install_signal_handler:
+            self._prev_handler = signal.signal(signal.SIGTERM, self._on_sigterm)
+
+    # ------------------------------------------------------------- signals
+    def _on_sigterm(self, signum, frame):
+        self.preempted = True
+
+    # ---------------------------------------------------------------- save
+    def _ckpt_file(self):
+        return os.path.join(self.path, "latest.pdckpt")
+
+    def _state(self, step):
+        state = {"step": int(step),
+                 "model": dict(self.model.state_dict())}
+        opt = self.optimizer
+        if opt is not None:
+            inner = getattr(opt, "_inner_opt", opt)
+            params_by_id = {id(t): k for k, t in state["model"].items()}
+            acc = {}
+            for acc_name, store in getattr(inner, "_accumulators", {}).items():
+                for pid, v in store.items():
+                    pname = params_by_id.get(pid)
+                    if pname is not None:
+                        acc[f"{pname}::{acc_name}"] = v
+            state["opt_acc"] = acc
+            state["opt_step_count"] = getattr(inner, "_step_count", 0)
+        return state
+
+    def save(self, step):
+        if self.rank != 0:
+            return
+        from ....framework.io_utils import save as paddle_save
+
+        os.makedirs(self.path, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=self.path, suffix=".tmp")
+        os.close(fd)
+        try:
+            paddle_save(self._state(step), tmp)
+            os.replace(tmp, self._ckpt_file())  # atomic on POSIX
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+
+    def resume(self) -> int:
+        """Load the latest checkpoint into model/optimizer; returns the step
+        AFTER the saved one (the next step to run), or 0 on a fresh start."""
+        f = self._ckpt_file()
+        if not os.path.exists(f):
+            return 0
+        from ....framework.io_utils import load as paddle_load
+
+        state = paddle_load(f)
+        self.model.set_state_dict(state["model"])
+        opt = self.optimizer
+        if opt is not None and "opt_acc" in state:
+            inner = getattr(opt, "_inner_opt", opt)
+            params = dict(self.model.state_dict())
+            for key, v in state["opt_acc"].items():
+                pname, acc_name = key.rsplit("::", 1)
+                t = params.get(pname)
+                if t is not None:
+                    inner._accumulators.setdefault(acc_name, {})[id(t)] = (
+                        v._value if hasattr(v, "_value") else v)
+            inner._step_count = state.get("opt_step_count", 0)
+        return int(state["step"]) + 1
+
+    # ---------------------------------------------------------------- step
+    def step(self, step_i):
+        """Call once per training step, AFTER the optimizer update."""
+        if self.preempted:
+            self.save(step_i)
+            os._exit(ELASTIC_EXIT_CODE)
+        if self.save_every and (step_i + 1) % self.save_every == 0:
+            self.save(step_i)

@@ -44,6 +44,9 @@ def parse_args(argv=None):
                    help="restart the pod this many times if a worker fails")
     p.add_argument("--heartbeat_interval", type=float, default=5.0,
                    help="seconds between worker heartbeats to the store")
+    p.add_argument("--stop_grace", type=float, default=30.0,
+                   help="seconds to wait after SIGTERM before SIGKILL on pod "
+                        "teardown (must cover a preemption autocheckpoint)")
     p.add_argument("--heartbeat_timeout", type=float, default=0.0,
                    help="declare a worker hung after this many seconds without a "
                         "heartbeat (0 = disabled)")

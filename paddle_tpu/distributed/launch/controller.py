@@ -14,6 +14,9 @@ import subprocess
 import sys
 import time
 
+from ..fleet.elastic.manager import (
+    ELASTIC_AUTO_PARALLEL_EXIT_CODE, ELASTIC_EXIT_CODE,
+)
 from ..store import TCPStore
 from .context import Context
 
@@ -31,7 +34,8 @@ class CollectiveController:
         self.ctx = ctx
         self.store = None
         self.procs: list[WorkerProc] = []
-        self._restarts = 0
+        self._restarts = 0   # crash-restart budget consumed
+        self._attempts = 0   # total relaunches (rendezvous numbering)
         self._interrupted = False
         self._remote_restart = False
 
@@ -59,7 +63,7 @@ class CollectiveController:
             cmd_base = [sys.executable, "-u", script] + script_args
         else:
             cmd_base = [script] + script_args
-        attempt = self._restarts
+        attempt = self._attempts
         for local_rank in range(ctx.nproc_per_node):
             rank = ctx.rank_of(local_rank)
             log_path = os.path.join(ctx.log_dir, f"workerlog.{local_rank}")
@@ -74,7 +78,11 @@ class CollectiveController:
             logf.close()
             self.procs.append(WorkerProc(local_rank, rank, proc, log_path))
 
-    def stop_pod(self, sig=signal.SIGTERM, grace=10.0):
+    def stop_pod(self, sig=signal.SIGTERM, grace=None):
+        if grace is None:
+            # must outlive a worker's preemption autocheckpoint (SIGTERM ->
+            # save -> exit); SIGKILL before the save completes loses the step
+            grace = getattr(self.ctx.args, "stop_grace", 30.0)
         for w in self.procs:
             if w.proc.poll() is None:
                 try:
@@ -131,15 +139,22 @@ class CollectiveController:
             remote = self._remote_restart
             if code == 0:
                 return 0
-            if self._interrupted or (not remote and
+            # ELASTIC_EXIT_CODE = preemption/scale event: restart for free
+            # (reference manager.py:33 — an elastic event is not a crash)
+            elastic = code in (ELASTIC_EXIT_CODE,
+                               ELASTIC_AUTO_PARALLEL_EXIT_CODE)
+            if self._interrupted or (not remote and not elastic and
                                      self._restarts >= self.ctx.args.max_restarts):
                 if self.ctx.nnodes > 1 and self.store is not None:
                     self.store.set("__launch/abort", str(code))
                 return code
-            self._restarts += 1
-            n = self._restarts
-            print(f"[launch] pod failed (exit {code}); restart "
-                  f"{n}/{self.ctx.args.max_restarts}", flush=True)
+            if not elastic:
+                self._restarts += 1
+            self._attempts += 1
+            n = self._attempts
+            print(f"[launch] pod {'preempted' if elastic else 'failed'} "
+                  f"(exit {code}); restart (crash budget "
+                  f"{self._restarts}/{self.ctx.args.max_restarts})", flush=True)
             if self.store is not None:
                 if self.ctx.nnodes > 1:
                     if not remote:
@@ -161,7 +176,7 @@ class CollectiveController:
         self.store.clear()
         self.store.set("job/nnodes", str(self.ctx.nnodes))
         self.store.set("job/world_size", str(self.ctx.world_size))
-        self.store.set("job/restart_attempt", str(self._restarts))
+        self.store.set("job/restart_attempt", str(self._attempts))
 
     def _check_remote_signals(self):
         """Another node may have requested a job-wide restart or abort."""
@@ -174,7 +189,7 @@ class CollectiveController:
                 return int(raw.decode()) or 1
             except ValueError:
                 return 1
-        raw = self.store.get(f"__launch/restart_req/{self._restarts + 1}", wait=False)
+        raw = self.store.get(f"__launch/restart_req/{self._attempts + 1}", wait=False)
         if raw is not None:
             self._remote_restart = True
             try:

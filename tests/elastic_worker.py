@@ -1,0 +1,70 @@
+"""Elastic test worker: trains a deterministic tiny model with
+AutoCheckpointer; crash / preemption behavior driven by env vars.
+
+ELASTIC_TEST_MODE:
+  crash      — rank 1 exits(1) at step CRASH_STEP on attempt 0 only
+  preempt    — rank 0 receives a self-SIGTERM at step CRASH_STEP on attempt 0
+Writes per-step losses to ELASTIC_LOG (one "attempt rank step loss" line per
+step) for the parent test to assert loss continuity across the restart."""
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed.fleet.elastic import AutoCheckpointer
+
+RANK = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+ATTEMPT = int(os.environ.get("PADDLE_RESTART_ATTEMPT", "0"))
+MODE = os.environ.get("ELASTIC_TEST_MODE", "")
+CRASH_STEP = int(os.environ.get("ELASTIC_CRASH_STEP", "5"))
+TOTAL = int(os.environ.get("ELASTIC_TOTAL_STEPS", "10"))
+CKPT = os.environ["ELASTIC_CKPT_DIR"]
+LOG = os.environ["ELASTIC_LOG"]
+
+
+def log(step, loss):
+    with open(f"{LOG}.{RANK}", "a") as f:
+        f.write(f"{ATTEMPT} {RANK} {step} {loss:.6f}\n")
+
+
+def main():
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+    opt = paddle.optimizer.Adam(parameters=model.parameters(),
+                                learning_rate=0.05)
+    ckpt = AutoCheckpointer(model, opt, path=CKPT, save_every=1, rank=RANK)
+    start = ckpt.resume()
+    rs = np.random.RandomState(42)
+    data = [(rs.randn(8, 4).astype("float32"),
+             rs.randn(8, 1).astype("float32")) for _ in range(TOTAL)]
+    step_delay = float(os.environ.get("ELASTIC_STEP_DELAY", "0"))
+    for step in range(start, TOTAL):
+        if step_delay:
+            time.sleep(step_delay)  # keep ranks mid-run when the pod dies
+        x, y = data[step]
+        loss = ((model(paddle.to_tensor(x)) - paddle.to_tensor(y)) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        log(step, float(loss.numpy()))
+        if ATTEMPT == 0 and step == CRASH_STEP:
+            if MODE == "crash" and RANK == 1:
+                os._exit(1)
+            if MODE == "preempt" and RANK == 0:
+                os.kill(os.getpid(), signal.SIGTERM)  # simulated pod eviction
+        ckpt.step(step)
+    print(f"rank {RANK} done at step {TOTAL - 1}")
+
+
+if __name__ == "__main__":
+    main()

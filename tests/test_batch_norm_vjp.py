@@ -100,10 +100,3 @@ def test_bn_residual_grad_dtype_preserved():
     paddle.sum(paddle.cast(out, "float32")).backward()
     assert str(res.grad.dtype) in ("float32", "paddle.float32")
     assert str(x.grad.dtype) in ("bfloat16", "paddle.bfloat16")
-
-
-def test_gpt_recompute_validation():
-    from paddle_tpu.models.gpt import GPTConfig
-
-    with pytest.raises(ValueError, match="recompute"):
-        GPTConfig(recompute="dot")

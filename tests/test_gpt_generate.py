@@ -115,3 +115,10 @@ def test_recompute_dots_loss_parity():
     for remat in ("dots", "block"):
         np.testing.assert_allclose(losses[remat], losses[None],
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_gpt_recompute_validation():
+    from paddle_tpu.models.gpt import GPTConfig
+
+    with pytest.raises(ValueError, match="recompute"):
+        GPTConfig(recompute="dot")

@@ -135,7 +135,7 @@ def test_launch_restart_on_failure(tmp_path):
     r = _run_launch(["--nproc_per_node", "2", "--max_restarts", "1",
                      "--log_dir", str(tmp_path)], worker_args=("--fail-once",))
     logs = _read_results(tmp_path, 2)
-    assert "restart 1/1" in r.stdout, (r.stdout, r.stderr)
+    assert "crash budget 1/1" in r.stdout, (r.stdout, r.stderr)
     assert r.returncode == 0, (r.stdout, r.stderr, logs)
 
 
