@@ -207,3 +207,5 @@ def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
             f"check_numerics failed for {op_type}:{var_name}: {n_nan} NaN, {n_inf} Inf"
         )
     return n_nan, n_inf
+
+from . import debugging  # noqa: E402,F401

@@ -173,9 +173,45 @@ def _sharded_over(v, g: Group):
         return False
 
 
-def _eager_smap(g: Group, fn, v, out_specs):
+# ------------------------------------------------------------- comm tracking
+# Per-collective in-flight record (reference comm_task_manager.cc:66 role):
+# the heartbeat thread publishes it alongside hb/<rank>, so when a worker's
+# heartbeat goes stale the controller can name the collective it died inside
+# instead of reporting silence.
+_COMM_TASK = {"op": None, "seq": 0, "start": 0.0}
+
+
+class _track_comm:
+    def __init__(self, op):
+        self.op = op
+
+    def __enter__(self):
+        import time as _t
+
+        _COMM_TASK["op"] = self.op
+        _COMM_TASK["seq"] += 1
+        _COMM_TASK["start"] = _t.time()
+        return self
+
+    def __exit__(self, *exc):
+        _COMM_TASK["op"] = None
+        return False
+
+
+def current_comm_task():
+    """(op, seq, age_seconds) of the in-flight collective, or None."""
+    import time as _t
+
+    op = _COMM_TASK["op"]
+    if op is None:
+        return None
+    return (op, _COMM_TASK["seq"], _t.time() - _COMM_TASK["start"])
+
+
+def _eager_smap(g: Group, fn, v, out_specs, op_name="collective"):
     ax = g.axis_name
-    return g.shard_map(fn, PartitionSpec(ax), out_specs)(v)
+    with _track_comm(op_name):
+        return g.shard_map(fn, PartitionSpec(ax), out_specs)(v)
 
 
 # --------------------------------------------------------------------- reduces
@@ -209,7 +245,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         fn = _reduce_fn(op)
         # reduce the per-device shards; result replicated across the group
         tensor._value = _eager_smap(g, lambda s: fn(s, g.axis_name), v,
-                                    PartitionSpec())
+                                    PartitionSpec(), op_name="all_reduce")
         return tensor
     return tensor
 
@@ -225,7 +261,8 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
         return tensor_list
     if not _in_trace(v) and g.jax_mesh is not None and _sharded_over(v, g):
         gathered = _eager_smap(
-            g, lambda s: jax.lax.all_gather(s, g.axis_name), v, PartitionSpec())
+            g, lambda s: jax.lax.all_gather(s, g.axis_name), v,
+            PartitionSpec(), op_name="all_gather")
         for i in range(gathered.shape[0]):
             tensor_list.append(Tensor(gathered[i]))
         return tensor_list
@@ -267,7 +304,7 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     if not _in_trace(v) and g.jax_mesh is not None and _sharded_over(v, g):
         tensor._value = _eager_smap(
             g, lambda s: jax.lax.all_gather(s, g.axis_name)[src_idx], v,
-            PartitionSpec(g.axis_name))
+            PartitionSpec(g.axis_name), op_name="broadcast")
         return tensor
     return tensor
 

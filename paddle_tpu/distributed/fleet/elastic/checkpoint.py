@@ -59,15 +59,14 @@ class AutoCheckpointer:
         opt = self.optimizer
         if opt is not None:
             inner = getattr(opt, "_inner_opt", opt)
-            params_by_id = {id(t): k for k, t in state["model"].items()}
-            acc = {}
-            for acc_name, store in getattr(inner, "_accumulators", {}).items():
-                for pid, v in store.items():
-                    pname = params_by_id.get(pid)
-                    if pname is not None:
-                        acc[f"{pname}::{acc_name}"] = v
-            state["opt_acc"] = acc
-            state["opt_step_count"] = getattr(inner, "_step_count", 0)
+            # the optimizer's own (de)serializers carry accumulators by
+            # parameter NAME plus LR-scheduler state and the step counter
+            state["opt"] = inner.state_dict()
+            mw = getattr(inner, "_master_weights", None)
+            if mw:
+                names = inner._param_names()
+                state["opt_master"] = {
+                    names[pid]: v for pid, v in mw.items() if pid in names}
         return state
 
     def save(self, step):
@@ -96,16 +95,17 @@ class AutoCheckpointer:
         state = paddle_load(f)
         self.model.set_state_dict(state["model"])
         opt = self.optimizer
-        if opt is not None and "opt_acc" in state:
+        if opt is not None and "opt" in state:
             inner = getattr(opt, "_inner_opt", opt)
-            params = dict(self.model.state_dict())
-            for key, v in state["opt_acc"].items():
-                pname, acc_name = key.rsplit("::", 1)
-                t = params.get(pname)
-                if t is not None:
-                    inner._accumulators.setdefault(acc_name, {})[id(t)] = (
-                        v._value if hasattr(v, "_value") else v)
-            inner._step_count = state.get("opt_step_count", 0)
+            inner.set_state_dict(state["opt"])
+            if "opt_master" in state:
+                names = {v: k for k, v in inner._param_names().items()}
+                mw = {}
+                for pname, v in state["opt_master"].items():
+                    pid = names.get(pname)
+                    if pid is not None:
+                        mw[pid] = v._value if hasattr(v, "_value") else v
+                inner._master_weights = mw
         return int(state["step"]) + 1
 
     # ---------------------------------------------------------------- step

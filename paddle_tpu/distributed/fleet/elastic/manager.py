@@ -77,14 +77,16 @@ class ElasticManager:
                 self._registered_slot = slot
                 self.heartbeat()
                 return slot
-            if owner is None:
-                if self.store.add(f"elastic/claim/{slot}", 1) == 1:
-                    self.store.set(self._slot_key(slot), self.node_id)
-                    self._registered_slot = slot
-                    self.heartbeat()
-                    return slot
-                continue  # someone else claimed it first
+            if owner is None and self.store.add(f"elastic/claim/{slot}", 1) == 1:
+                # first-ever claimant of a virgin slot
+                self.store.set(self._slot_key(slot), self.node_id)
+                self._registered_slot = slot
+                self.heartbeat()
+                return slot
             if not self._slot_alive(slot):
+                # stale lease OR a freed/abandoned slot (owner deregistered,
+                # or a claimant died before setting the owner key): race the
+                # reclaim through a per-generation counter
                 gen_raw = self.store.get(f"elastic/gen/{slot}", wait=False)
                 gen = int(gen_raw.decode()) if gen_raw else 0
                 if self.store.add(f"elastic/reclaim/{slot}/{gen}", 1) == 1:

@@ -21,6 +21,15 @@ from ..store import TCPStore
 from .context import Context
 
 
+def _pick_exit_code(codes):
+    """A real crash concurrent with a preemption must be billed as a crash:
+    any non-elastic code outranks the elastic (free-restart) codes."""
+    non_elastic = [c for c in codes
+                   if c not in (ELASTIC_EXIT_CODE,
+                                ELASTIC_AUTO_PARALLEL_EXIT_CODE)]
+    return non_elastic[0] if non_elastic else codes[0]
+
+
 class WorkerProc:
     def __init__(self, local_rank, rank, proc, log_path):
         self.local_rank = local_rank
@@ -115,12 +124,14 @@ class CollectiveController:
             raw = self.store.get(f"hb/{w.rank}", wait=False)
             if raw is None:
                 continue  # worker hasn't started heartbeating yet
+            text = raw.decode()
+            ts_part, _, task_part = text.partition("|")
             try:
-                ts = float(raw.decode())
+                ts = float(ts_part)
             except ValueError:
                 continue
             if now - ts > timeout:
-                hung.append(w)
+                hung.append((w, task_part or None))
         return hung
 
     def watch(self, poll_interval=0.5):
@@ -211,18 +222,21 @@ class CollectiveController:
                 if all(s is not None for s in statuses):
                     bad = [s for s in statuses if s != 0]
                     self.procs = []
-                    return bad[0] if bad else 0
+                    return _pick_exit_code(bad) if bad else 0
                 failed = [w for w in self.procs if w.proc.poll() not in (None, 0)]
                 hung = self._hung_workers()
                 if failed or hung:
                     for w in failed:
                         print(f"[launch] rank {w.rank} exited "
                               f"{w.proc.poll()}; see {w.log_path}", flush=True)
-                    for w in hung:
+                    for w, task in hung:
+                        where = (f" inside collective {task}" if task
+                                 else "")
                         print(f"[launch] rank {w.rank} heartbeat stale "
-                              f"(> {self.ctx.args.heartbeat_timeout}s); killing pod",
-                              flush=True)
-                    code = failed[0].proc.poll() if failed else 124
+                              f"(> {self.ctx.args.heartbeat_timeout}s)"
+                              f"{where}; killing pod", flush=True)
+                    code = (_pick_exit_code([w.proc.poll() for w in failed])
+                            if failed else 124)
                     self.stop_pod()
                     return code
                 time.sleep(poll_interval)

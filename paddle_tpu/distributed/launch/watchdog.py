@@ -46,7 +46,19 @@ class Heartbeat:
             misses = 0
             while not self._stop.is_set():
                 try:
-                    self.store.set(f"hb/{self.rank}", str(time.time()))
+                    hb = str(time.time())
+                    # attach the in-flight collective (comm_task_manager role):
+                    # on a hang the controller names WHAT the rank died inside
+                    try:
+                        from ..collective import current_comm_task
+
+                        task = current_comm_task()
+                        if task is not None:
+                            op, seq, age = task
+                            hb += f"|{op}:{seq}:{age:.1f}s"
+                    except Exception:
+                        pass
+                    self.store.set(f"hb/{self.rank}", hb)
                     misses = 0
                 except Exception:
                     # a transient store hiccup must not silence the heartbeat

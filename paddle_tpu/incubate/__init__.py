@@ -1,3 +1,5 @@
 """paddle.incubate staging ground. Reference: python/paddle/incubate/."""
 from . import distributed  # noqa: F401
 from . import nn  # noqa: F401
+
+from . import asp  # noqa: E402,F401

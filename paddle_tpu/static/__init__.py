@@ -50,3 +50,42 @@ def default_startup_program():
 
 from . import nn  # noqa: E402,F401
 from .nn.control_flow import Assert, Print  # noqa: E402,F401
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         **kwargs):
+    """Reference: python/paddle/static/io.py save_inference_model. The
+    trace-and-compile world has no Program, so the deployable artifact is the
+    jax.export bundle `paddle.jit.save` writes; this entry accepts either the
+    reference calling convention with a Layer in place of fetch_vars, or
+    (layer, input_spec) via kwargs.
+
+    Usage: save_inference_model(prefix, input_specs, layer) where input_specs
+    is a list of InputSpec and layer the model to export."""
+    from .. import jit as _jit
+
+    layer = kwargs.pop("layer", None)
+    input_spec = kwargs.pop("input_spec", None)
+    if layer is None and hasattr(fetch_vars, "state_dict"):
+        layer, input_spec = fetch_vars, feed_vars
+    if layer is None:
+        raise TypeError(
+            "save_inference_model needs the model Layer: pass it as "
+            "fetch_vars (with InputSpecs as feed_vars) or layer=...")
+    if input_spec is not None and not isinstance(input_spec, (list, tuple)):
+        input_spec = [input_spec]
+    _jit.save(layer, path_prefix, input_spec=input_spec, **kwargs)
+    return path_prefix
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Reference: static/io.py load_inference_model, which returns
+    [program, feed_names, fetch_targets]. Here the 'program' is the loaded
+    callable (a TranslatedLayer-role object from paddle.jit.load); feed/fetch
+    names come from its exported signature when available."""
+    from .. import jit as _jit
+
+    fn = _jit.load(path_prefix, **kwargs)
+    feed_names = list(getattr(fn, "input_names", []) or [])
+    fetch_targets = list(getattr(fn, "output_names", []) or [])
+    return [fn, feed_names, fetch_targets]
