@@ -58,6 +58,7 @@ from . import audio  # noqa: E402
 from . import text  # noqa: E402
 from . import quantization  # noqa: E402
 from . import signal  # noqa: E402
+from . import onnx  # noqa: E402
 from . import geometric  # noqa: E402
 from .framework.flags import get_flags, set_flags  # noqa: E402,F401
 from .framework.io_utils import save, load  # noqa: E402,F401

@@ -95,7 +95,18 @@ class AutoCheckpointer:
         state = paddle_load(f)
         self.model.set_state_dict(state["model"])
         opt = self.optimizer
-        if opt is not None and "opt" in state:
+        if opt is not None and "opt_acc" in state:
+            # legacy (round-4 interim) format: accumulators keyed name::acc
+            inner = getattr(opt, "_inner_opt", opt)
+            params = dict(self.model.state_dict())
+            for key, v in state["opt_acc"].items():
+                pname, acc_name = key.rsplit("::", 1)
+                t = params.get(pname)
+                if t is not None:
+                    inner._accumulators.setdefault(acc_name, {})[id(t)] = (
+                        v._value if hasattr(v, "_value") else v)
+            inner._step_count = state.get("opt_step_count", 0)
+        elif opt is not None and "opt" in state:
             inner = getattr(opt, "_inner_opt", opt)
             inner.set_state_dict(state["opt"])
             if "opt_master" in state:

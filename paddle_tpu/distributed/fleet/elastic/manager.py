@@ -77,16 +77,19 @@ class ElasticManager:
                 self._registered_slot = slot
                 self.heartbeat()
                 return slot
-            if owner is None and self.store.add(f"elastic/claim/{slot}", 1) == 1:
-                # first-ever claimant of a virgin slot
-                self.store.set(self._slot_key(slot), self.node_id)
-                self._registered_slot = slot
-                self.heartbeat()
-                return slot
-            if not self._slot_alive(slot):
-                # stale lease OR a freed/abandoned slot (owner deregistered,
-                # or a claimant died before setting the owner key): race the
-                # reclaim through a per-generation counter
+            if owner is None:
+                # virgin slot: the atomic claim counter decides; a loser must
+                # NOT fall through to reclaim (the winner may not have written
+                # its owner key / heartbeat yet — that is not staleness)
+                if self.store.add(f"elastic/claim/{slot}", 1) == 1:
+                    self.store.set(self._slot_key(slot), self.node_id)
+                    self._registered_slot = slot
+                    self.heartbeat()
+                    return slot
+                continue
+            if owner == "" or not self._slot_alive(slot):
+                # "" = deregister tombstone; otherwise a stale lease. Race the
+                # reclaim through a per-generation counter.
                 gen_raw = self.store.get(f"elastic/gen/{slot}", wait=False)
                 gen = int(gen_raw.decode()) if gen_raw else 0
                 if self.store.add(f"elastic/reclaim/{slot}/{gen}", 1) == 1:
@@ -106,7 +109,10 @@ class ElasticManager:
     def deregister(self):
         if self._registered_slot is not None:
             self.store.delete_key(self._hb_key(self._registered_slot))
-            self.store.delete_key(self._slot_key(self._registered_slot))
+            # tombstone ("" owner) marks the slot re-claimable via the
+            # generation counter; deleting it would make the slot look virgin
+            # while its one-shot claim counter stays spent
+            self.store.set(self._slot_key(self._registered_slot), "")
             self._registered_slot = None
 
     def _slot_alive(self, slot) -> bool:
@@ -128,7 +134,7 @@ class ElasticManager:
         out = {}
         for rank, slot in enumerate(self.alive_slots()):
             raw = self.store.get(self._slot_key(slot), wait=False)
-            if raw is not None:
+            if raw:
                 out[raw.decode()] = rank
         return out
 

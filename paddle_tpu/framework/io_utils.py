@@ -87,10 +87,17 @@ def _looks_like_reference_ckpt(obj):
     vals = list(obj.values())
     if not vals or any(isinstance(v, _TensorPayload) for v in vals):
         return False
-    return all(
-        (isinstance(v, tuple) and len(v) == 2 and isinstance(v[0], str)
-         and isinstance(v[1], np.ndarray)) or isinstance(v, np.ndarray)
-        for v in vals)
+
+    def _is_eager_tuple(v):
+        return (isinstance(v, tuple) and len(v) == 2
+                and isinstance(v[0], str) and isinstance(v[1], np.ndarray))
+
+    # require at least one eager-tensor tuple (every real dygraph state dict
+    # has them) — an all-ndarray dict is ambiguous with OUR OWN save format
+    # and must round-trip unchanged
+    if not any(_is_eager_tuple(v) for v in vals):
+        return False
+    return all(_is_eager_tuple(v) or isinstance(v, np.ndarray) for v in vals)
 
 
 def load(path, **configs):

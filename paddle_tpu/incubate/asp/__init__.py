@@ -8,6 +8,8 @@ hook), so the API surface and the n:m mask math are kept bit-compatible while
 execution stays dense-with-mask."""
 from __future__ import annotations
 
+import weakref
+
 import numpy as np
 
 import jax.numpy as jnp
@@ -19,7 +21,10 @@ __all__ = ["calculate_density", "decorate", "prune_model",
            "set_excluded_layers", "reset_excluded_layers", "check_sparsity"]
 
 _EXCLUDED: set = set()
-_MASKS: dict = {}  # id(param) -> (param, mask jnp array)
+# id(param) -> (weakref(param), mask): weakrefs let discarded models be
+# garbage-collected and make id-reuse harmless (dead entries are dropped
+# on the next decorated step)
+_MASKS: dict = {}
 
 
 def calculate_density(x) -> float:
@@ -72,8 +77,11 @@ def prune_model(model: Layer, n=2, m=4, mask_algo="mask_1d", with_mask=True):
     so `decorate`d optimizers re-apply them after each step.
 
     Reference: asp.prune_model (asp/asp.py)."""
-    if mask_algo not in ("mask_1d", "mask_2d_greedy", "mask_2d_best"):
-        raise ValueError(f"unknown mask_algo {mask_algo!r}")
+    if mask_algo != "mask_1d":
+        raise NotImplementedError(
+            f"mask_algo {mask_algo!r}: only 'mask_1d' is implemented (the "
+            "reference's mask_2d_* search the 2-D pattern space; silently "
+            "substituting mask_1d would diverge numerically)")
     masks = {}
     for name, p in _prunable_params(model):
         w = np.asarray(p._value)
@@ -81,7 +89,7 @@ def prune_model(model: Layer, n=2, m=4, mask_algo="mask_1d", with_mask=True):
         jmask = jnp.asarray(mask, dtype=p._value.dtype)
         p._value = p._value * jmask
         if with_mask:
-            _MASKS[id(p)] = (p, jmask)
+            _MASKS[id(p)] = (weakref.ref(p), jmask)
         masks[name] = mask
     return masks
 
@@ -98,8 +106,15 @@ class OptimizerWithSparsityGuarantee:
 
     def step(self):
         self._optimizer.step()
-        for p, mask in _MASKS.values():
+        dead = []
+        for pid, (ref, mask) in _MASKS.items():
+            p = ref()
+            if p is None:
+                dead.append(pid)
+                continue
             p._value = p._value * mask
+        for pid in dead:
+            del _MASKS[pid]
 
 
 def decorate(optimizer):

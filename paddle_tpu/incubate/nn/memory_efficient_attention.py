@@ -27,19 +27,15 @@ def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,
 
     causal = isinstance(attn_bias, str) and attn_bias.lower() == "causal"
     if causal or attn_bias is None:
-        out, _ = F.flash_attention(
-            query, key, value, dropout=p if training else 0.0,
-            causal=causal, training=training)
+        q = query
         if scale is not None:
-            # flash kernel bakes in 1/sqrt(d); rescale for a custom scale
-            d = query.shape[-1]
-            ratio = scale * math.sqrt(d)
+            # flash kernel bakes in 1/sqrt(d): pre-scale the query once
+            ratio = scale * math.sqrt(query.shape[-1])
             if abs(ratio - 1.0) > 1e-9:
-                out2, _ = F.flash_attention(
-                    query * ratio, key, value,
-                    dropout=p if training else 0.0, causal=causal,
-                    training=training)
-                return out2
+                q = query * ratio
+        out, _ = F.flash_attention(
+            q, key, value, dropout=p if training else 0.0,
+            causal=causal, training=training)
         return out
 
     def f(q, k, v, bias):
@@ -48,6 +44,12 @@ def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,
         logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * s
         logits = logits + bias.astype(logits.dtype)
         probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        if p and training:
+            from ...framework import random as _rng
+
+            keep = 1.0 - p
+            mask = jax.random.bernoulli(_rng.next_key(), keep, probs.shape)
+            probs = jnp.where(mask, probs / keep, 0.0)
         return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
 
     return apply_op(f, "memory_efficient_attention", query, key, value,

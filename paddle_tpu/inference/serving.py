@@ -1,0 +1,182 @@
+"""Serving: dynamic batching + an HTTP endpoint over the Predictor.
+
+Reference role: the AnalysisPredictor deployment stack (paddle/fluid/
+inference/, ~90K C++) + Paddle Serving's request batching. TPU-native shape:
+one resident compiled program per batch bucket; a collector thread coalesces
+concurrent requests into a single device launch (decode/serving throughput on
+TPU is batch-bound — see docs/PERF.md serving numbers), then splits results.
+The HTTP front end is a stdlib ThreadingHTTPServer speaking npz, so a client
+needs nothing but numpy.
+"""
+from __future__ import annotations
+
+import io
+import queue
+import threading
+
+import numpy as np
+
+__all__ = ["BatchingPredictor", "InferenceServer"]
+
+
+class _Request:
+    def __init__(self, arrays):
+        self.arrays = arrays
+        self.event = threading.Event()
+        self.result = None
+        self.error = None
+
+
+class BatchingPredictor:
+    """Coalesce concurrent single requests into batched Predictor.run calls.
+
+    Requests are padded to the next bucket size (powers of two up to
+    `max_batch_size`) so the number of compiled programs stays bounded —
+    dynamic shapes would recompile per batch size otherwise."""
+
+    def __init__(self, predictor, max_batch_size=8, max_delay_ms=2.0):
+        self.predictor = predictor
+        self.max_batch_size = int(max_batch_size)
+        self.max_delay = max_delay_ms / 1000.0
+        self._queue: queue.Queue = queue.Queue()
+        self._stop = threading.Event()
+        self.batch_sizes: list[int] = []  # observability: actual batch fill
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="batching-predictor")
+        self._thread.start()
+
+    # ---------------------------------------------------------------- client
+    def infer(self, *arrays, timeout=None):
+        """One logical sample in (arrays WITHOUT the batch dim), one out."""
+        req = _Request([np.asarray(a) for a in arrays])
+        self._queue.put(req)
+        if not req.event.wait(timeout):
+            raise TimeoutError("inference request timed out")
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    # ---------------------------------------------------------------- worker
+    def _bucket(self, n):
+        b = 1
+        while b < n:
+            b *= 2
+        return min(b, self.max_batch_size)
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                first = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            batch = [first]
+            deadline = threading.Event()
+            deadline.wait(self.max_delay)  # collection window
+            while len(batch) < self.max_batch_size:
+                try:
+                    batch.append(self._queue.get_nowait())
+                except queue.Empty:
+                    break
+            self._run_batch(batch)
+
+    def _run_batch(self, batch):
+        try:
+            n = len(batch)
+            bucket = self._bucket(n)
+            self.batch_sizes.append(n)
+            stacked = []
+            for i in range(len(batch[0].arrays)):
+                arr = np.stack([r.arrays[i] for r in batch])
+                if bucket > n:  # pad to the bucket to bound compilations
+                    pad = np.repeat(arr[:1], bucket - n, axis=0)
+                    arr = np.concatenate([arr, pad], axis=0)
+                stacked.append(arr)
+            outs = self.predictor.run(stacked)
+            for j, r in enumerate(batch):
+                r.result = [o[j] for o in outs]
+                r.event.set()
+        except Exception as e:  # pragma: no cover - propagated to callers
+            for r in batch:
+                r.error = e
+                r.event.set()
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=2)
+
+
+class InferenceServer:
+    """HTTP npz endpoint: POST /predict with an .npz body of inputs
+    (x0, x1, ...) -> .npz response of outputs (out0, ...). GET /health."""
+
+    def __init__(self, predictor, host="127.0.0.1", port=0, batching=True,
+                 max_batch_size=8, max_delay_ms=2.0):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        self.predictor = predictor
+        self.batcher = (BatchingPredictor(predictor, max_batch_size,
+                                          max_delay_ms) if batching else None)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_GET(self):
+                if self.path == "/health":
+                    self.send_response(200)
+                    self.end_headers()
+                    self.wfile.write(b"ok")
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+
+            def do_POST(self):
+                if self.path != "/predict":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    data = np.load(io.BytesIO(self.rfile.read(n)))
+                    def _num_key(k):
+                        digits = "".join(c for c in k if c.isdigit())
+                        return (int(digits) if digits else 0, k)
+
+                    arrays = [data[k] for k in sorted(data.files,
+                                                      key=_num_key)]
+                    if outer.batcher is not None:
+                        outs = outer.batcher.infer(*arrays, timeout=30)
+                    else:
+                        outs = [o[0] for o in outer.predictor.run(
+                            [a[None] for a in arrays])]
+                    buf = io.BytesIO()
+                    np.savez(buf, **{f"out{i}": o
+                                     for i, o in enumerate(outs)})
+                    body = buf.getvalue()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/npz")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except Exception as e:
+                    msg = repr(e).encode()
+                    self.send_response(500)
+                    self.send_header("Content-Length", str(len(msg)))
+                    self.end_headers()
+                    self.wfile.write(msg)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="inference-server")
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        if self.batcher is not None:
+            self.batcher.close()
+        self._thread.join(timeout=2)

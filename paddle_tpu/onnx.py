@@ -1,0 +1,39 @@
+"""paddle.onnx — ONNX export shim.
+
+Reference: python/paddle/onnx/export.py (144 lines: delegates entirely to the
+external `paddle2onnx` package and errors without it). Mirrored here: true
+ONNX emission needs external tooling this image does not ship; the portable
+TPU-native interchange format is the StableHLO bundle `paddle.jit.save`
+writes (loadable from any PJRT runtime), exposed as `export_stablehlo`.
+"""
+from __future__ import annotations
+
+__all__ = ["export", "export_stablehlo"]
+
+
+def export_stablehlo(layer, path, input_spec=None, **configs):
+    """Serialize `layer` as a StableHLO bundle (jax.export) at `path` — the
+    TPU-native portable artifact filling the ONNX interchange role."""
+    from . import jit
+
+    jit.save(layer, path, input_spec=input_spec, **configs)
+    return path
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Reference export.py: requires paddle2onnx/onnx tooling. Without it
+    (this image), raises with the supported alternative named — the same
+    failure mode the reference has without paddle2onnx installed."""
+    try:
+        import onnx  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "ONNX export needs the `onnx` + converter tooling, which is not "
+            "installed (the reference delegates to `paddle2onnx` the same "
+            "way). For a portable serialized model use "
+            "paddle.onnx.export_stablehlo(layer, path, input_spec=...) — a "
+            "StableHLO bundle loadable from any PJRT runtime."
+        ) from e
+    raise NotImplementedError(
+        "onnx is importable but no paddle2onnx-equivalent converter is "
+        "available; use export_stablehlo instead")
