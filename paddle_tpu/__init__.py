@@ -26,6 +26,11 @@ from .framework.device import (  # noqa: E402,F401
     CPUPlace, CUDAPlace, Place, TPUPlace, XPUPlace, get_device, set_device,
     is_compiled_with_cuda, is_compiled_with_xpu,
 )
+
+
+class CUDAPinnedPlace(Place):
+    """Reference CUDAPinnedPlace: pinned host memory for async H2D copies.
+    On TPU host arrays are already staged by PJRT; kept for API shape."""
 from .framework.random import seed, get_rng_state, set_rng_state  # noqa: E402,F401
 from .tensor import Tensor, to_tensor  # noqa: E402,F401
 from .autograd import no_grad, enable_grad, grad, is_grad_enabled, set_grad_enabled  # noqa: E402,F401
@@ -63,6 +68,33 @@ from . import geometric  # noqa: E402
 from .framework.flags import get_flags, set_flags  # noqa: E402,F401
 from .framework.io_utils import save, load  # noqa: E402,F401
 from .hapi.model import Model  # noqa: E402,F401
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Reference: hapi/model_summary.py paddle.summary — layer table +
+    parameter counts for a bare Layer (Model.summary wraps the same)."""
+    return Model(net).summary(input_size, dtypes)
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Reference: hapi/dynamic_flops.py paddle.flops — cost-analysis FLOPs of
+    one forward at `input_size` (XLA's counter replaces the per-op table)."""
+    import jax as _j
+    import numpy as _np
+
+    x = to_tensor(_np.zeros(input_size, "float32"))
+    state = net.raw_state()
+
+    def fwd(state, v):
+        out = net.functional_call(state, Tensor(v))
+        return out._value if hasattr(out, "_value") else out
+
+    lowered = _j.jit(fwd).lower(state, x._value)
+    cost = lowered.compile().cost_analysis() or {}
+    total = int(cost.get("flops", 0))
+    if print_detail:
+        print(f"Total Flops: {total}")
+    return total
 from .nn.layer import ParamAttr  # noqa: E402,F401
 
 # DataParallel lives at paddle.DataParallel in the reference
@@ -92,3 +124,30 @@ def device_count():
     from .framework import device as _d
 
     return _d.device_count()
+
+
+# dtype class + legacy string dtypes (reference exports them top-level)
+# paddle.dtype: numpy dtype IS the dtype object in this framework
+from numpy import dtype  # noqa: E402,F401
+
+#: reference experimental string-tensor dtypes (no TPU kernel support in the
+#: reference either outside the strings CPU kernels); placeholders for parity
+pstring = "pstring"
+raw = "raw"
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Reference: paddle.batch (legacy reader decorator): group a sample
+    reader into a batched reader."""
+
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched

@@ -34,3 +34,13 @@ from .layer_rnn import (  # noqa: F401
 )
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
 from . import utils  # noqa: F401
+
+from .layer_extra import (  # noqa: E402,F401
+    AdaptiveLogSoftmaxWithLoss, BeamSearchDecoder, BiRNN, ChannelShuffle,
+    FeatureAlphaDropout, Fold, FractionalMaxPool2D, FractionalMaxPool3D,
+    HSigmoidLoss, LPPool1D, LPPool2D, MaxUnPool1D, MaxUnPool2D, MaxUnPool3D,
+    MultiMarginLoss, PairwiseDistance, ParameterDict, PixelUnshuffle,
+    RNNCellBase, RNNTLoss, Softmax2D, SpectralNorm,
+    TripletMarginWithDistanceLoss, Unflatten, Unfold, ZeroPad1D, ZeroPad3D,
+    dynamic_decode,
+)

@@ -19,3 +19,12 @@ from ...ops.creation import one_hot  # noqa: F401
 
 # re-export select ops that paddle exposes under functional too
 from ...ops.math import clip  # noqa: F401
+
+from .extra import (  # noqa: E402,F401
+    adaptive_log_softmax_with_loss, class_center_sample,
+    feature_alpha_dropout, flash_attn_qkvpacked, flash_attn_varlen_qkvpacked,
+    fractional_max_pool2d, fractional_max_pool3d, gather_tree, hardtanh_,
+    hsigmoid_loss, leaky_relu_, lp_pool1d, lp_pool2d, margin_cross_entropy,
+    max_unpool1d, max_unpool2d, max_unpool3d, multi_margin_loss, npair_loss,
+    pairwise_distance, rnnt_loss, sparse_attention, tanh_, thresholded_relu_,
+)

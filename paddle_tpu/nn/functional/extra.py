@@ -1,0 +1,546 @@
+"""Functional fill-ins closing the nn.functional export gap.
+
+Reference: python/paddle/nn/functional/__init__.py (128 exports) — the
+round-1..3 sets covered 118; this module adds the tail: loss variants
+(hsigmoid / multi-margin / npair / rnnt / adaptive-log-softmax / margin CE),
+pooling variants (lp / fractional-max / max-unpool), distance, in-place
+activations, packed flash-attention wrappers, beam-search gather_tree,
+class_center_sample and sparse_attention."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops import apply_op
+from ...ops.parity import _graft
+from ...tensor import Tensor
+
+__all__ = [
+    "adaptive_log_softmax_with_loss", "class_center_sample",
+    "feature_alpha_dropout", "flash_attn_qkvpacked",
+    "flash_attn_varlen_qkvpacked", "fractional_max_pool2d",
+    "fractional_max_pool3d", "gather_tree", "hardtanh_", "hsigmoid_loss",
+    "leaky_relu_", "lp_pool1d", "lp_pool2d", "margin_cross_entropy",
+    "max_unpool1d", "max_unpool2d", "max_unpool3d", "multi_margin_loss",
+    "npair_loss", "pairwise_distance", "rnnt_loss", "sparse_attention",
+    "tanh_", "thresholded_relu_",
+]
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+# ------------------------------------------------------------- inplace acts
+def _inplace(fn_name):
+    def f(x, *args, **kw):
+        from .. import functional as F
+
+        return _graft(x, getattr(F, fn_name)(x, *args, **kw))
+
+    f.__name__ = fn_name + "_"
+    return f
+
+
+hardtanh_ = _inplace("hardtanh")
+leaky_relu_ = _inplace("leaky_relu")
+tanh_ = _inplace("tanh")
+thresholded_relu_ = _inplace("thresholded_relu")
+
+
+# ------------------------------------------------------------------ distance
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """Reference: functional/distance.py pairwise_distance."""
+
+    def f(a, b):
+        d = a - b + epsilon
+        return jnp.linalg.norm(jnp.abs(d), ord=p, axis=-1, keepdims=keepdim)
+
+    return apply_op(f, "pairwise_distance", x, y)
+
+
+# ------------------------------------------------------------------ pooling
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    from . import avg_pool1d
+
+    p = float(norm_type)
+
+    def powv(v):
+        return jnp.abs(v) ** p
+
+    xp = apply_op(powv, "lp_pow", x)
+    pooled = avg_pool1d(xp, kernel_size, stride, padding, ceil_mode=ceil_mode)
+    k = kernel_size if isinstance(kernel_size, int) else int(np.prod(kernel_size))
+    return apply_op(lambda v: (v * k) ** (1.0 / p), "lp_root", pooled)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    from . import avg_pool2d
+
+    p = float(norm_type)
+    xp = apply_op(lambda v: jnp.abs(v) ** p, "lp_pow", x)
+    pooled = avg_pool2d(xp, kernel_size, stride, padding, ceil_mode=ceil_mode)
+    if isinstance(kernel_size, int):
+        k = kernel_size * kernel_size
+    else:
+        k = int(np.prod(kernel_size))
+    return apply_op(lambda v: (v * k) ** (1.0 / p), "lp_root", pooled)
+
+
+def _fractional_bounds(in_size, out_size, u):
+    """Deterministic pseudo-random region boundaries (torch semantics with a
+    fixed sample u in [0,1))."""
+    alpha = in_size / out_size
+    idx = np.arange(out_size + 1)
+    b = np.ceil(alpha * (idx + u)) - np.ceil(alpha * u)
+    b = np.clip(b.astype(np.int64), 0, in_size)
+    b[-1] = in_size
+    return b
+
+
+def _fractional_pool(x, out_sizes, spatial_axes, random_u):
+    v = _val(x)
+    bounds = [
+        _fractional_bounds(v.shape[ax], o, random_u)
+        for ax, o in zip(spatial_axes, out_sizes)
+    ]
+
+    def f(v):
+        out = v
+        for dim_i, (ax, bnd) in enumerate(zip(spatial_axes, bounds)):
+            pieces = [
+                jnp.max(jnp.moveaxis(out, ax, 0)[bnd[i]:max(bnd[i + 1], bnd[i] + 1)],
+                        axis=0)
+                for i in range(len(bnd) - 1)
+            ]
+            out = jnp.moveaxis(jnp.stack(pieces, 0), 0, ax)
+        return out
+
+    return apply_op(f, "fractional_max_pool", x)
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """Reference: functional/pooling.py fractional_max_pool2d (NCHW)."""
+    os = ((output_size, output_size) if isinstance(output_size, int)
+          else tuple(output_size))
+    u = 0.5 if random_u is None else float(random_u)
+    out = _fractional_pool(x, os, (2, 3), u)
+    return (out, None) if return_mask else out
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    os = ((output_size,) * 3 if isinstance(output_size, int)
+          else tuple(output_size))
+    u = 0.5 if random_u is None else float(random_u)
+    out = _fractional_pool(x, os, (2, 3, 4), u)
+    return (out, None) if return_mask else out
+
+
+def _max_unpool(x, indices, spatial_ndim, kernel_size, stride, padding,
+                output_size):
+    ks = ((kernel_size,) * spatial_ndim if isinstance(kernel_size, int)
+          else tuple(kernel_size))
+    st = (ks if stride is None else
+          ((stride,) * spatial_ndim if isinstance(stride, int)
+           else tuple(stride)))
+    v = _val(x)
+    in_spatial = v.shape[2:]
+    if output_size is None:
+        out_spatial = tuple(
+            (s - 1) * st[i] + ks[i] for i, s in enumerate(in_spatial))
+    else:
+        out_spatial = tuple(output_size[-spatial_ndim:])
+
+    def f(v, idx):
+        B, C = v.shape[:2]
+        flat_sp = int(np.prod(out_spatial))
+        vflat = v.reshape(B, C, -1)
+        iflat = idx.reshape(B, C, -1).astype(jnp.int32)
+        out = jnp.zeros((B, C, flat_sp), v.dtype)
+        out = jax.vmap(jax.vmap(lambda o, i, s: o.at[i].set(s)))(
+            out, iflat, vflat)
+        return out.reshape((B, C) + out_spatial)
+
+    return apply_op(f, "max_unpool", x, indices)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    """Reference: functional/pooling.py max_unpool1d — scatter values back to
+    the argmax positions recorded by max_pool1d(return_mask=True)."""
+    return _max_unpool(x, indices, 1, kernel_size, stride, padding,
+                       output_size)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 2, kernel_size, stride, padding,
+                       output_size)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 3, kernel_size, stride, padding,
+                       output_size)
+
+
+# ------------------------------------------------------------------ losses
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """Reference: functional/loss.py multi_margin_loss."""
+
+    def f(x, y, w):
+        n, c = x.shape
+        tgt = jnp.take_along_axis(x, y[:, None].astype(jnp.int32), axis=1)
+        m = jnp.maximum(0.0, margin - tgt + x) ** p
+        if w is not None:
+            m = m * w[y.astype(jnp.int32)][:, None]
+        mask = jax.nn.one_hot(y.astype(jnp.int32), c, dtype=x.dtype)
+        loss = jnp.sum(m * (1 - mask), axis=1) / c
+        if reduction == "mean":
+            return jnp.mean(loss)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+
+    return apply_op(f, "multi_margin_loss", input, label, weight)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """Reference: functional/loss.py npair_loss (N-pair metric learning)."""
+
+    def f(a, p, y):
+        reg = l2_reg * (jnp.sum(jnp.square(a), 1).mean()
+                        + jnp.sum(jnp.square(p), 1).mean()) * 0.25
+        sim = a @ p.T
+        eq = (y[:, None] == y[None, :]).astype(sim.dtype)
+        tgt = eq / jnp.maximum(eq.sum(1, keepdims=True), 1.0)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        ce = -(tgt * logp).sum(1).mean()
+        return ce + reg
+
+    return apply_op(f, "npair_loss", anchor, positive, labels)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid over the default complete binary tree
+    (reference: functional/loss.py hsigmoid_loss; custom paths via
+    path_table/path_code)."""
+    depth = int(math.ceil(math.log2(max(num_classes, 2))))
+
+    def default_paths():
+        # heap layout: class c maps to leaf (c + num_classes); ancestors are
+        # successive halvings; code bit = child parity
+        table = np.zeros((num_classes, depth), np.int64)
+        code = np.zeros((num_classes, depth), np.float32)
+        for c in range(num_classes):
+            node = c + num_classes
+            for d in range(depth):
+                code[c, d] = float(node % 2)
+                node //= 2
+                table[c, d] = node - 1  # internal nodes 1.. -> rows 0..
+        return jnp.asarray(table), jnp.asarray(code)
+
+    if path_table is None:
+        tbl, code = default_paths()
+    else:
+        tbl, code = _val(path_table).astype(jnp.int64), _val(path_code).astype(jnp.float32)
+
+    def f(x, y, w, b):
+        y = y.reshape(-1).astype(jnp.int32)
+        t = tbl[y]              # [N, depth] internal-node ids
+        cde = code[y]           # [N, depth] 0/1
+        wt = w[t]               # [N, depth, D]
+        logits = jnp.einsum("nd,nkd->nk", x, wt)
+        if b is not None:
+            logits = logits + b.reshape(-1)[t]
+        # per-node binary CE: -log sigma((1-2*code)*logit)
+        sgn = 1.0 - 2.0 * cde
+        loss = jax.nn.softplus(-sgn * logits).sum(1, keepdims=True)
+        return loss
+
+    return apply_op(f, "hsigmoid_loss", input, label, weight, bias)
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """Reference: functional/loss.py adaptive_log_softmax_with_loss (torch
+    semantics: frequency-clustered softmax). Returns (output, loss)."""
+    n_clusters = len(cutoffs) - 1  # cutoffs includes n_classes at the end
+    head_size = cutoffs[0] + n_clusters
+
+    def f(x, y, hw, hb, *tails):
+        y = y.reshape(-1).astype(jnp.int32)
+        head_logits = x @ hw
+        if hb is not None:
+            head_logits = head_logits + hb
+        head_logp = jax.nn.log_softmax(head_logits, axis=-1)
+        out = jnp.zeros(y.shape, x.dtype)
+        # in-head targets
+        in_head = y < cutoffs[0]
+        head_part = jnp.take_along_axis(
+            head_logp, jnp.clip(y, 0, cutoffs[0] - 1)[:, None], 1)[:, 0]
+        out = jnp.where(in_head, head_part, out)
+        for i in range(n_clusters):
+            lo, hi = cutoffs[i], cutoffs[i + 1]
+            w1, w2 = tails[2 * i], tails[2 * i + 1]
+            cluster_logp = head_logp[:, cutoffs[0] + i]
+            proj = (x @ w1) @ w2
+            tail_logp = jax.nn.log_softmax(proj, axis=-1)
+            rel = jnp.clip(y - lo, 0, hi - lo - 1)
+            part = cluster_logp + jnp.take_along_axis(
+                tail_logp, rel[:, None], 1)[:, 0]
+            out = jnp.where((y >= lo) & (y < hi), part, out)
+        return out, -jnp.mean(out)
+
+    tails_flat = [w for pair in tail_weights for w in pair]
+    return apply_op(f, "adaptive_log_softmax_with_loss", input, label,
+                    head_weight, head_bias, *tails_flat, nout=2)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean"):
+    """Reference: functional/loss.py margin_cross_entropy (ArcFace-family
+    combined margin: cos(m1*theta + m2) - m3 on the target logit)."""
+
+    def f(lg, y):
+        y = y.reshape(-1).astype(jnp.int32)
+        lg32 = jnp.clip(lg.astype(jnp.float32), -1.0, 1.0)
+        theta = jnp.arccos(
+            jnp.take_along_axis(lg32, y[:, None], 1)[:, 0])
+        tgt = jnp.cos(margin1 * theta + margin2) - margin3
+        onehot = jax.nn.one_hot(y, lg.shape[-1], dtype=lg32.dtype)
+        adjusted = lg32 * (1 - onehot) + tgt[:, None] * onehot
+        adjusted = adjusted * scale
+        logp = jax.nn.log_softmax(adjusted, axis=-1)
+        loss = -jnp.take_along_axis(logp, y[:, None], 1)
+        if reduction == "mean":
+            loss = jnp.mean(loss)
+        elif reduction == "sum":
+            loss = jnp.sum(loss)
+        if return_softmax:
+            return loss, jnp.exp(logp)
+        return loss
+
+    if return_softmax:
+        return apply_op(f, "margin_cross_entropy", logits, label, nout=2)
+    return apply_op(f, "margin_cross_entropy", logits, label)
+
+
+def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-Transducer loss: log-space DP over the (T, U) lattice via
+    lax.scan along anti-diagonals-free row order (reference:
+    functional/loss.py rnnt_loss / warprnnt kernels).
+
+    logits: [B, T, U+1, V] joint network outputs."""
+
+    def f(lg, lab, tlen, ulen):
+        logp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
+        B, T, U1, V = logp.shape
+        U = U1 - 1
+        lab = lab.astype(jnp.int32)
+        # per-position emit (label) and blank log-probs
+        blank_lp = logp[..., blank]                      # [B, T, U+1]
+        emit_lp = jnp.take_along_axis(
+            logp[:, :, :U, :], lab[:, None, :, None].repeat(T, 1), axis=3
+        )[..., 0]                                        # [B, T, U]
+        neg_inf = jnp.float32(-1e30)
+
+        # alpha[t, u]: rows computed by scan over t, prefix-scan over u
+        def row_step(prev_row, t):
+            # prev_row: alpha[t-1, :] (U+1); this row: alpha[t, :]
+            from_top = prev_row + blank_lp[:, t - 1, :]  # advance t via blank
+
+            def u_step(carry, u):
+                # advance u via emit within row t
+                left = carry + emit_lp[:, t, u]
+                cur = jnp.logaddexp(from_top[:, u + 1], left)
+                return cur, cur
+
+            first = from_top[:, 0]
+            _, rest = jax.lax.scan(
+                u_step, first, jnp.arange(U))
+            row = jnp.concatenate([first[:, None],
+                                   jnp.swapaxes(rest, 0, 1)], axis=1)
+            return row, None
+
+        # t = 0 row: only emits
+        def u0_step(carry, u):
+            cur = carry + emit_lp[:, 0, u]
+            return cur, cur
+
+        zero = jnp.zeros((B,), jnp.float32)
+        _, r0 = jax.lax.scan(u0_step, zero, jnp.arange(U))
+        row0 = jnp.concatenate([zero[:, None], jnp.swapaxes(r0, 0, 1)], 1)
+        # mask columns beyond each sample's label length
+        cols = jnp.arange(U1)[None, :]
+        row0 = jnp.where(cols <= ulen[:, None], row0, neg_inf)
+
+        def scan_rows(row, t):
+            new = row_step(row, t)[0]
+            new = jnp.where(cols <= ulen[:, None], new, neg_inf)
+            return new, new
+
+        last, rows = jax.lax.scan(scan_rows, row0, jnp.arange(1, T))
+        all_rows = jnp.concatenate([row0[None], rows], axis=0)  # [T, B, U+1]
+        # total log-prob: alpha[tlen-1, ulen] + blank at (tlen-1, ulen)
+        t_idx = jnp.clip(tlen.astype(jnp.int32) - 1, 0, T - 1)
+        alpha_fin = all_rows[t_idx, jnp.arange(B), :]
+        a_end = jnp.take_along_axis(
+            alpha_fin, ulen.astype(jnp.int32)[:, None], 1)[:, 0]
+        b_end = blank_lp[jnp.arange(B), t_idx, ulen.astype(jnp.int32)]
+        nll = -(a_end + b_end)
+        if reduction == "mean":
+            return jnp.mean(nll)
+        if reduction == "sum":
+            return jnp.sum(nll)
+        return nll
+
+    return apply_op(f, "rnnt_loss", logits, labels, logit_lengths,
+                    label_lengths)
+
+
+# --------------------------------------------------------------- attention
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False, return_softmax=False,
+                         training=True, name=None):
+    """qkv: [B, S, 3, H, D] packed (reference flash_attention.py
+    flash_attn_qkvpacked). Unpacks and runs the Pallas flash kernel."""
+    from . import flash_attention as _fa
+
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    return _fa.flash_attention(q, k, v, dropout=dropout, causal=causal,
+                               return_softmax=return_softmax,
+                               training=training)
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
+                                max_seqlen_k, scale=None, dropout=0.0,
+                                causal=False, return_softmax=False,
+                                training=True, name=None):
+    """qkv: [total, 3, H, D] packed varlen (reference
+    flash_attn_varlen_qkvpacked)."""
+    from . import flash_attention as _fa
+
+    q = qkv[:, 0]
+    k = qkv[:, 1]
+    v = qkv[:, 2]
+    if scale is None:
+        scale = 1.0 / math.sqrt(int(_val(q).shape[-1]))
+    return _fa.flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                                   max_seqlen_q, max_seqlen_k, scale,
+                                   dropout=dropout, causal=causal,
+                                   training=training)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Block-sparse attention (reference: functional/sparse_attention — CUDA
+    only there, CSR pattern per head). Executed as masked dense attention:
+    positions absent from the CSR pattern get -inf (numerically identical;
+    a Pallas blocked kernel is the perf path for very long sequences)."""
+
+    def f(q, k, v, offs, cols):
+        B, H, S, D = q.shape
+        scale = 1.0 / math.sqrt(D)
+        scores = jnp.einsum("bhsd,bhtd->bhst", q, k) * scale
+        # CSR -> dense mask [B, H, S, S]
+        row_ids = jnp.arange(S)
+        counts = offs[..., 1:] - offs[..., :-1]          # [B, H, S]
+        mask = jnp.zeros((B, H, S, S), bool)
+
+        def fill(b_mask, bh):
+            b, h = bh // H, bh % H
+            def row(m, s):
+                lo = offs[b, h, s]
+                hi = offs[b, h, s + 1]
+                idx = jnp.arange(cols.shape[-1])
+                sel = (idx >= lo) & (idx < hi)
+                cols_s = jnp.where(sel, cols[b, h], -1)
+                return m.at[s, jnp.clip(cols_s, 0, S - 1)].max(
+                    sel.astype(bool)), None
+            m2, _ = jax.lax.scan(row, b_mask[b, h], row_ids)
+            return b_mask.at[b, h].set(m2), None
+
+        b_mask, _ = jax.lax.scan(fill, mask, jnp.arange(B * H))
+        scores = jnp.where(b_mask, scores, jnp.float32(-1e30))
+        probs = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("bhst,bhtd->bhsd", probs.astype(v.dtype), v)
+
+    return apply_op(f, "sparse_attention", query, key, value,
+                    sparse_csr_offset, sparse_csr_columns)
+
+
+# --------------------------------------------------------------- utilities
+def gather_tree(ids, parents):
+    """Beam-search ancestor walk (reference: functional/gather_tree):
+    ids/parents [T, B, W] -> full sequences by backtracking parent beams."""
+
+    def f(ids, par):
+        T, B, W = ids.shape
+
+        def step(beams, t):
+            # beams: the beam index at time t+1 we came from
+            tok = jnp.take_along_axis(ids[t], beams, axis=-1)
+            prev = jnp.take_along_axis(par[t], beams, axis=-1)
+            return prev, tok
+
+        init = jnp.tile(jnp.arange(W)[None, :], (B, 1))
+        _, toks = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+        return jnp.flip(toks, 0)
+
+    return apply_op(f, "gather_tree", ids, parents)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Reference: functional/common.py class_center_sample (PartialFC):
+    sample the positive class centers + random negatives; returns
+    (remapped_label, sampled_class_index)."""
+    lab = np.asarray(_val(label)).astype(np.int64)
+    pos = np.unique(lab)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        rest = np.setdiff1d(np.arange(num_classes), pos)
+        rng = np.random.default_rng(int(pos.sum()) + num_classes)
+        extra = rng.choice(rest, size=num_samples - len(pos), replace=False)
+        sampled = np.sort(np.concatenate([pos, extra]))
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    from ...tensor import to_tensor
+
+    return (to_tensor(remap[lab]), to_tensor(sampled))
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """Alpha dropout over whole channel maps (reference:
+    functional/common.py feature_alpha_dropout: SELU-preserving statistics,
+    channel-granular mask)."""
+    if not training or p == 0.0:
+        return x
+
+    alpha = -1.7580993408473766
+
+    def f(v):
+        from ...framework import random as _rng
+
+        keep = 1.0 - p
+        mask_shape = v.shape[:2] + (1,) * (v.ndim - 2)
+        mask = jax.random.bernoulli(_rng.next_key(), keep, mask_shape)
+        a = (keep + alpha ** 2 * keep * (1 - keep)) ** -0.5
+        b = -a * alpha * (1 - keep)
+        return a * jnp.where(mask, v, alpha) + b
+
+    return apply_op(f, "feature_alpha_dropout", x)

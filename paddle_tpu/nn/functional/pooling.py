@@ -98,18 +98,65 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusiv
     return _pool(x, kernel_size, stride, padding, 3, data_format, "avg", ceil_mode, exclusive)
 
 
+def _max_pool_with_mask(x, kernel_size, stride, padding, n):
+    """Max pool returning (values, argmax indices into the flattened spatial
+    plane) — the torch/paddle return_mask convention consumed by max_unpool."""
+    ks = _tuple(kernel_size, n)
+    st = _tuple(stride if stride is not None else kernel_size, n)
+    pd = _tuple(padding, n)
+
+    def f(v):
+        neg = jnp.finfo(v.dtype).min if jnp.issubdtype(v.dtype, jnp.floating) \
+            else jnp.iinfo(v.dtype).min
+        pad_width = [(0, 0), (0, 0)] + [(p, p) for p in pd]
+        vp = jnp.pad(v, pad_width, constant_values=neg)
+        B, C = vp.shape[:2]
+        patches = jax.lax.conv_general_dilated_patches(
+            vp, filter_shape=list(ks), window_strides=list(st),
+            padding=[(0, 0)] * n)                     # [B, C*K, *out]
+        K = 1
+        for k in ks:
+            K *= k
+        out_sp = patches.shape[2:]
+        patches = patches.reshape(B, C, K, *out_sp)
+        # linear index (into the UNPADDED plane) extracted the same way; the
+        # padded border positions never win the argmax (value = min)
+        lin = -jnp.ones((1, 1) + v.shape[2:], jnp.float32)
+        flat = jnp.arange(int(np.prod(v.shape[2:])), dtype=jnp.float32)
+        lin = flat.reshape((1, 1) + v.shape[2:])
+        linp = jnp.pad(lin, pad_width, constant_values=-1.0)
+        lpatches = jax.lax.conv_general_dilated_patches(
+            linp, filter_shape=list(ks), window_strides=list(st),
+            padding=[(0, 0)] * n).reshape(1, 1, K, *out_sp)
+        am = jnp.argmax(patches, axis=2)              # [B, C, *out]
+        idx = jnp.take_along_axis(
+            jnp.broadcast_to(lpatches, (B, C, K) + out_sp), am[:, :, None],
+            axis=2)[:, :, 0]
+        return jnp.max(patches, axis=2), idx.astype(jnp.int32)
+
+    from ...ops import apply_op as _ap
+
+    return _ap(f, "max_pool_with_mask", x, nout=2)
+
+
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
                name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 1)
     return _pool(x, kernel_size, stride, padding, 1, "NCW", "max", ceil_mode)
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
                data_format="NCHW", name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 2)
     return _pool(x, kernel_size, stride, padding, 2, data_format, "max", ceil_mode)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
                data_format="NCDHW", name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 3)
     return _pool(x, kernel_size, stride, padding, 3, data_format, "max", ceil_mode)
 
 

@@ -1,0 +1,539 @@
+"""Layer fill-ins closing the paddle.nn export gap (the reference's 141-layer
+surface minus the round-1..3 set). Reference: python/paddle/nn/__init__.py;
+each class cites its reference module."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import apply_op
+from ..tensor import Tensor
+from .layer import Layer
+from .layer_rnn import _RNNCellBase
+from . import functional as F  # circular-safe: functional imports no layers
+
+__all__ = [
+    "AdaptiveLogSoftmaxWithLoss", "BeamSearchDecoder", "BiRNN",
+    "ChannelShuffle", "FeatureAlphaDropout", "Fold", "FractionalMaxPool2D",
+    "FractionalMaxPool3D", "HSigmoidLoss", "LPPool1D", "LPPool2D",
+    "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D", "MultiMarginLoss",
+    "PairwiseDistance", "ParameterDict", "PixelUnshuffle", "RNNCellBase",
+    "RNNTLoss", "Softmax2D", "SpectralNorm", "TripletMarginWithDistanceLoss",
+    "Unflatten", "Unfold", "ZeroPad1D", "ZeroPad3D", "dynamic_decode",
+]
+
+RNNCellBase = _RNNCellBase  # reference exports the cell base class
+
+
+# ------------------------------------------------------------- thin wrappers
+class ChannelShuffle(Layer):
+    """Reference: nn/layer/vision.py ChannelShuffle (NCHW)."""
+
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self.groups = groups
+
+    def forward(self, x):
+        from ..vision.models.shufflenetv2 import channel_shuffle
+
+        return channel_shuffle(x, self.groups)
+
+
+class PixelUnshuffle(Layer):
+    """Reference: nn/layer/vision.py PixelUnshuffle — inverse of PixelShuffle."""
+
+    def __init__(self, downscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self.r = downscale_factor
+
+    def forward(self, x):
+        r = self.r
+
+        def f(v):
+            b, c, h, w = v.shape
+            v = v.reshape(b, c, h // r, r, w // r, r)
+            return v.transpose(0, 1, 3, 5, 2, 4).reshape(
+                b, c * r * r, h // r, w // r)
+
+        return apply_op(f, "pixel_unshuffle", x)
+
+
+class Softmax2D(Layer):
+    """Reference: nn/layer/activation.py Softmax2D — softmax over channels."""
+
+    def forward(self, x):
+        return apply_op(lambda v: jax.nn.softmax(v, axis=-3), "softmax2d", x)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis, self.shape = axis, shape
+
+    def forward(self, x):
+        from ..ops.parity import unflatten
+
+        return unflatten(x, self.axis, self.shape)
+
+
+class ZeroPad1D(Layer):
+    """Reference: nn/layer/common.py ZeroPad1D (NCL)."""
+
+    def __init__(self, padding, data_format="NCL", name=None):
+        super().__init__()
+        self.padding = ([padding, padding] if isinstance(padding, int)
+                        else list(padding))
+
+    def forward(self, x):
+        return F.pad(x, self.padding, mode="constant", value=0.0,
+                     data_format="NCL")
+
+
+class ZeroPad3D(Layer):
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__()
+        self.padding = ([padding] * 6 if isinstance(padding, int)
+                        else list(padding))
+
+    def forward(self, x):
+        return F.pad(x, self.padding, mode="constant", value=0.0,
+                     data_format="NCDHW")
+
+
+class Fold(Layer):
+    """Reference: nn/layer/common.py Fold (col2im)."""
+
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self.a = (output_sizes, kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        o, k, s, p, d = self.a
+        return F.fold(x, o, k, strides=s, paddings=p, dilations=d)
+
+
+class Unfold(Layer):
+    """Reference: nn/layer/common.py Unfold (im2col)."""
+
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
+                 name=None):
+        super().__init__()
+        self.a = (kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        k, s, p, d = self.a
+        return F.unfold(x, k, strides=s, paddings=p, dilations=d)
+
+
+class FeatureAlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        from .functional.extra import feature_alpha_dropout
+
+        return feature_alpha_dropout(x, self.p, training=self.training)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.args = (p, epsilon, keepdim)
+
+    def forward(self, x, y):
+        from .functional.extra import pairwise_distance
+
+        p, eps, kd = self.args
+        return pairwise_distance(x, y, p, eps, kd)
+
+
+class ParameterDict(Layer):
+    """Reference: nn/layer/container.py ParameterDict."""
+
+    def __init__(self, parameters=None):
+        super().__init__()
+        if parameters:
+            for k, v in (parameters.items()
+                         if isinstance(parameters, dict) else parameters):
+                self.add_parameter(k, v)
+
+    def __getitem__(self, key):
+        return self._parameters[key]
+
+    def __setitem__(self, key, param):
+        self.add_parameter(key, param)
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters)
+
+    def keys(self):
+        return self._parameters.keys()
+
+    def items(self):
+        return self._parameters.items()
+
+    def values(self):
+        return self._parameters.values()
+
+    def update(self, parameters):
+        for k, v in (parameters.items()
+                     if isinstance(parameters, dict) else parameters):
+            self.add_parameter(k, v)
+
+
+# ------------------------------------------------------------------ pooling
+class LPPool1D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCL", name=None):
+        super().__init__()
+        self.a = (norm_type, kernel_size, stride, padding, ceil_mode)
+
+    def forward(self, x):
+        from .functional.extra import lp_pool1d
+
+        return lp_pool1d(x, *self.a)
+
+
+class LPPool2D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__()
+        self.a = (norm_type, kernel_size, stride, padding, ceil_mode)
+
+    def forward(self, x):
+        from .functional.extra import lp_pool2d
+
+        return lp_pool2d(x, *self.a)
+
+
+class _MaxUnPoolNd(Layer):
+    _fn = None
+
+    def __init__(self, kernel_size, stride=None, padding=0, data_format=None,
+                 output_size=None, name=None):
+        super().__init__()
+        self.a = (kernel_size, stride, padding, output_size)
+
+    def forward(self, x, indices):
+        from .functional import extra
+
+        k, s, p, o = self.a
+        return getattr(extra, self._fn)(x, indices, k, stride=s, padding=p,
+                                        output_size=o)
+
+
+class MaxUnPool1D(_MaxUnPoolNd):
+    _fn = "max_unpool1d"
+
+
+class MaxUnPool2D(_MaxUnPoolNd):
+    _fn = "max_unpool2d"
+
+
+class MaxUnPool3D(_MaxUnPoolNd):
+    _fn = "max_unpool3d"
+
+
+class FractionalMaxPool2D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.a = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        from .functional.extra import fractional_max_pool2d
+
+        return fractional_max_pool2d(x, *self.a)
+
+
+class FractionalMaxPool3D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.a = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        from .functional.extra import fractional_max_pool3d
+
+        return fractional_max_pool3d(x, *self.a)
+
+
+# ------------------------------------------------------------------ losses
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.a = (p, margin, weight, reduction)
+
+    def forward(self, input, label):
+        from .functional.extra import multi_margin_loss
+
+        p, m, w, r = self.a
+        return multi_margin_loss(input, label, p, m, w, r)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    """Reference: nn/layer/loss.py TripletMarginWithDistanceLoss (custom
+    distance_function instead of the p-norm)."""
+
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.distance_function = distance_function
+        self.margin = margin
+        self.swap = swap
+        self.reduction = reduction
+
+    def forward(self, input, positive, negative):
+        from .functional.extra import pairwise_distance
+
+        dist = self.distance_function or (
+            lambda a, b: pairwise_distance(a, b, 2.0))
+        dp = dist(input, positive)
+        dn = dist(input, negative)
+        if self.swap:
+            from ..ops.math import minimum
+
+            dn = minimum(dn, dist(positive, negative))
+
+        def f(dp, dn):
+            loss = jnp.maximum(dp - dn + self.margin, 0.0)
+            if self.reduction == "mean":
+                return jnp.mean(loss)
+            if self.reduction == "sum":
+                return jnp.sum(loss)
+            return loss
+
+        return apply_op(f, "triplet_margin_with_distance", dp, dn)
+
+
+class HSigmoidLoss(Layer):
+    """Reference: nn/layer/loss.py HSigmoidLoss (hierarchical sigmoid with
+    learned internal-node weights)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        self.num_classes = num_classes
+        n_nodes = max(num_classes - 1, 1)
+        std = 1.0 / math.sqrt(feature_size)
+        from . import initializer as I
+
+        self.weight = self.create_parameter(
+            [n_nodes * 2, feature_size], attr=weight_attr,
+            default_initializer=I.Uniform(-std, std))
+        self.bias = self.create_parameter([n_nodes * 2], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        from .functional.extra import hsigmoid_loss
+
+        return hsigmoid_loss(input, label, self.num_classes, self.weight,
+                             self.bias, path_table, path_code)
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.a = (blank, fastemit_lambda, reduction)
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        from .functional.extra import rnnt_loss
+
+        b, fl, r = self.a
+        return rnnt_loss(input, label, input_lengths, label_lengths, b, fl, r)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """Reference: nn/layer/loss.py AdaptiveLogSoftmaxWithLoss (frequency-
+    clustered softmax; torch-compatible semantics)."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        cutoffs = list(cutoffs)
+        self.cutoffs = cutoffs + [n_classes]
+        self.n_clusters = len(cutoffs)
+        self.head_size = cutoffs[0] + self.n_clusters
+        from . import initializer as I
+
+        std = 1.0 / math.sqrt(in_features)
+        self.head_weight = self.create_parameter(
+            [in_features, self.head_size],
+            default_initializer=I.Uniform(-std, std))
+        self.head_bias = (self.create_parameter([self.head_size],
+                                                is_bias=True)
+                          if head_bias else None)
+        self.tail_weights = []
+        for i in range(self.n_clusters):
+            hsz = max(1, int(in_features / (div_value ** (i + 1))))
+            osz = self.cutoffs[i + 1] - self.cutoffs[i]
+            w1 = self.create_parameter([in_features, hsz],
+                                       default_initializer=I.Uniform(-std, std))
+            w2 = self.create_parameter([hsz, osz],
+                                       default_initializer=I.Uniform(-std, std))
+            self.add_parameter(f"tail_{i}_0", w1)
+            self.add_parameter(f"tail_{i}_1", w2)
+            self.tail_weights.append((w1, w2))
+
+    def forward(self, input, label):
+        from .functional.extra import adaptive_log_softmax_with_loss
+
+        return adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, self.tail_weights, self.cutoffs,
+            self.head_bias)
+
+
+# ------------------------------------------------------------------ norm
+class SpectralNorm(Layer):
+    """Reference: nn/layer/norm.py SpectralNorm — weight / sigma_max via
+    power iteration."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12,
+                 dtype="float32"):
+        super().__init__()
+        self.dim = dim
+        self.power_iters = power_iters
+        self.epsilon = epsilon
+        h = weight_shape[dim]
+        w = int(np.prod(weight_shape)) // h
+        from . import initializer as I
+
+        self.weight_u = self.create_parameter(
+            [h], default_initializer=I.Normal(0.0, 1.0))
+        self.weight_v = self.create_parameter(
+            [w], default_initializer=I.Normal(0.0, 1.0))
+        self.weight_u.stop_gradient = True
+        self.weight_v.stop_gradient = True
+
+    def forward(self, x):
+        eps = self.epsilon
+        iters = self.power_iters
+        dim = self.dim
+
+        def f(w, u, v):
+            mat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+            for _ in range(max(iters, 1)):
+                v = mat.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = mat @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            sigma = u @ mat @ v
+            return w / sigma
+
+        out = apply_op(f, "spectral_norm", x, self.weight_u, self.weight_v)
+        return out
+
+
+# ------------------------------------------------------------- seq2seq decode
+class BiRNN(Layer):
+    """Reference: nn/layer/rnn.py BiRNN — run a forward and a backward cell
+    over the sequence and concatenate features."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from .layer_rnn import RNN
+
+        fw = RNN(self.cell_fw, time_major=self.time_major)
+        bw = RNN(self.cell_bw, time_major=self.time_major)
+        s_fw, s_bw = (initial_states if initial_states is not None
+                      else (None, None))
+        out_fw, st_fw = fw(inputs, s_fw)
+        # reverse time, run, reverse back
+        axis = 0 if self.time_major else 1
+        from ..ops.parity import reverse as rev
+
+        out_bw, st_bw = bw(rev(inputs, axis), s_bw)
+        out_bw = rev(out_bw, axis)
+        from ..ops.manipulation import concat
+
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+class BeamSearchDecoder:
+    """Reference: nn/decode.py BeamSearchDecoder — beam search over an RNN
+    cell with an embedding fn + output projection."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None, **kwargs):
+    """Reference: nn/decode.py dynamic_decode. Host-loop beam search (the
+    decode loop is short and data-dependent; each step's cell call is the
+    compiled part). Returns (ids [B, beam, T], final scores [B, beam])."""
+    cell = decoder.cell
+    B = kwargs.get("batch_size", 1)
+    K = decoder.beam_size
+    T = max_step_num or 16
+
+    tok = np.full((B * K,), decoder.start_token, np.int64)
+    scores = np.zeros((B, K), np.float32)
+    scores[:, 1:] = -1e9  # first step: all beams identical, keep one
+    states = inits
+    seqs = [np.tile(tok.reshape(B, K, 1), 1)]
+    finished = np.zeros((B, K), bool)
+    from ..tensor import to_tensor
+
+    for _ in range(T):
+        emb = (decoder.embedding_fn(to_tensor(tok))
+               if decoder.embedding_fn else to_tensor(tok))
+        out, states = cell(emb, states)
+        logits = decoder.output_fn(out) if decoder.output_fn else out
+        logp = np.asarray(
+            jax.nn.log_softmax(logits._value.astype(jnp.float32), axis=-1)
+        ).reshape(B, K, -1)
+        V = logp.shape[-1]
+        logp = np.where(finished[..., None],
+                        np.eye(V)[decoder.end_token] * 0.0 - 1e9 * (
+                            1 - np.eye(V)[decoder.end_token]), logp)
+        total = scores[..., None] + logp
+        flat = total.reshape(B, -1)
+        top = np.argsort(-flat, axis=1)[:, :K]
+        scores = np.take_along_axis(flat, top, 1)
+        beam_src = top // V
+        tok2d = top % V
+        seqs = [np.take_along_axis(s, beam_src[..., None], 1) for s in seqs]
+        seqs.append(tok2d[..., None])
+        finished = np.take_along_axis(finished, beam_src, 1) | (
+            tok2d == decoder.end_token)
+        tok = tok2d.reshape(-1).astype(np.int64)
+        # reorder recurrent states along the beam axis
+        states = jax.tree_util.tree_map(
+            lambda s: _reorder_beam(s, beam_src, B, K), states)
+        if finished.all():
+            break
+    ids = np.concatenate(seqs[1:], axis=-1)
+    return to_tensor(ids), to_tensor(scores)
+
+
+def _reorder_beam(state, beam_src, B, K):
+    if not isinstance(state, Tensor):
+        return state
+    v = np.asarray(state._value)
+    v = v.reshape(B, K, *v.shape[1:])
+    idx = beam_src.reshape(B, K, *([1] * (v.ndim - 2)))
+    v = np.take_along_axis(v, idx, 1)
+    from ..tensor import to_tensor
+
+    return to_tensor(v.reshape(B * K, *v.shape[2:]))

@@ -368,5 +368,46 @@ from .search import *  # noqa: F401,F403,E402
 from .linalg import *  # noqa: F401,F403,E402
 from .random import *  # noqa: F401,F403,E402
 from .einsum import einsum  # noqa: F401,E402
+from .parity import *  # noqa: F401,F403,E402
+from . import parity as _parity  # noqa: E402
+
+# in-place variants: <op>_ mutates the tensor, keeping tape linkage
+_INPLACE_BASES = [
+    "abs", "acos", "addmm", "atan", "bernoulli", "bitwise_and",
+    "bitwise_left_shift", "bitwise_not", "bitwise_or", "bitwise_right_shift",
+    "bitwise_xor", "cast", "copysign", "cos", "cumprod", "cumsum", "digamma",
+    "divide", "equal", "erf", "expm1", "floor_divide", "frac", "gcd",
+    "greater_equal", "greater_than", "hypot", "i0", "index_add", "index_put",
+    "lcm", "ldexp", "less_equal", "less_than", "lgamma", "log10", "log2",
+    "log", "log_normal", "logical_and", "logical_not", "logical_or", "logit",
+    "masked_fill", "masked_scatter", "mod", "multiply", "nan_to_num", "neg",
+    "pow", "remainder", "scatter", "sin", "sinh", "square", "t", "tan",
+    "tanh", "transpose", "tril", "triu", "trunc", "where", "gammaln",
+    "gammainc", "gammaincc", "multigammaln", "polygamma", "renorm", "sinc",
+    "floor_mod", "less",
+]
+# aliases the reference exports under second names
+bitwise_invert = globals().get("bitwise_not")
+for _base in _INPLACE_BASES:
+    _fn = globals().get(_base)
+    if _fn is not None and callable(_fn):
+        globals()[_base + "_"] = _parity.make_inplace(_fn, _base + "_")
+bitwise_invert_ = globals().get("bitwise_not_")
+less_ = globals().get("less_than_")
+floor_mod_ = globals().get("mod_")
+
+# bind every generated in-place variant (and add_/sub_ method aliases) onto
+# Tensor, mirroring the reference's monkey_patch_tensor inplace set
+from ..tensor import Tensor as _T  # noqa: E402
+
+for _n, _f in list(globals().items()):
+    if _n.endswith("_") and not _n.startswith("_") and callable(_f) \
+            and not hasattr(_T, _n):
+        setattr(_T, _n, _f)
+if not hasattr(_T, "add_"):
+    _T.add_ = _parity.make_inplace(globals()["add"], "add_")
+if not hasattr(_T, "subtract_"):
+    _T.subtract_ = _parity.make_inplace(globals()["subtract"], "subtract_")
+
 
 from . import patch_methods  # noqa: E402  (binds Tensor methods/operators)
