@@ -1,5 +1,8 @@
 """Reference training models (SURVEY.md §7.0: the model zoo lives downstream in the
 reference; these are the in-repo baseline-config drivers)."""
 from .gpt import (  # noqa: F401
-    GPTConfig, GPTForCausalLM, GPTModel, gpt3_1p3b, gpt_tiny, llama2_7b,
+    GPTConfig, GPTForCausalLM, GPTModel, gpt3_1p3b, gpt_tiny,
+)
+from .llama import (  # noqa: F401
+    LlamaConfig, LlamaForCausalLM, LlamaModel, llama2_7b, llama_tiny,
 )

@@ -429,14 +429,6 @@ def gpt3_1p3b():
                      use_rope=False, use_rms_norm=False, use_swiglu=False)
 
 
-def llama2_7b():
-    """LLaMA-2-7B (BASELINE config 5)."""
-    return GPTConfig(vocab_size=32000, hidden_size=4096, num_layers=32, num_heads=32,
-                     num_kv_heads=32, intermediate_size=11008, max_position=4096,
-                     use_rope=True, use_rms_norm=True, use_swiglu=True,
-                     tie_embeddings=False)
-
-
 def gpt_tiny():
     return GPTConfig(vocab_size=1024, hidden_size=64, num_layers=2, num_heads=4,
                      max_position=128)

@@ -1,0 +1,114 @@
+"""LLaMA model (BASELINE config 5) + ZeRO stage-3 trajectory parity.
+
+VERDICT r4 item 2: stage-3 gather-on-use semantics (reference
+group_sharded_stage3.py:904,1019) expressed as GSPMD layouts must not change
+the 5-step loss trajectory vs the unsharded single-device run.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.jit.train import TrainStep
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+B, S = 4, 32
+
+
+def _data(cfg):
+    rs = np.random.RandomState(0)
+    x = rs.randint(0, cfg.vocab_size, (B, S)).astype(np.int64)
+    return x, np.roll(x, -1, axis=1)
+
+
+def _run(stage, steps=5):
+    mesh = dist.auto_mesh(8, dim_names=["dp"]) if stage is not None else None
+    prev = dist.get_mesh()
+    dist.set_mesh(mesh)
+    try:
+        paddle.seed(0)
+        cfg = llama_tiny()
+        model = LlamaForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        if stage is not None:
+            opt = dist.shard_optimizer(opt, stage("dp", mesh))
+        step = TrainStep(model, lambda logits, loss: loss, opt)
+        x, y = _data(cfg)
+        xt, yt = paddle.to_tensor(x), paddle.to_tensor(y)
+        losses = [float(step(xt, labels=yt)) for _ in range(steps)]
+        return losses, model, step, (xt, yt)
+    finally:
+        dist.set_mesh(prev)
+
+
+def test_forward_shapes_and_gqa():
+    paddle.seed(0)
+    cfg = llama_tiny()
+    assert cfg.num_kv_heads < cfg.num_heads  # real GQA
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    x, _ = _data(cfg)
+    logits = model(paddle.to_tensor(x))
+    assert tuple(logits.shape) == (B, S, cfg.vocab_size)
+    names = dict(model.named_parameters())
+    # LLaMA checkpoint naming is part of the contract (reference import maps by name)
+    for frag in ("self_attn.q_proj", "self_attn.o_proj", "mlp.gate_proj",
+                 "mlp.down_proj", "input_layernorm", "post_attention_layernorm"):
+        assert any(frag in n for n in names), frag
+
+
+def test_causality():
+    """Future-token perturbation must not change earlier logits."""
+    paddle.seed(0)
+    cfg = llama_tiny()
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    x, _ = _data(cfg)
+    a = np.asarray(model(paddle.to_tensor(x))._value)
+    x2 = x.copy()
+    x2[:, -1] = (x2[:, -1] + 1) % cfg.vocab_size
+    b = np.asarray(model(paddle.to_tensor(x2))._value)
+    np.testing.assert_allclose(a[:, :-1], b[:, :-1], rtol=1e-5, atol=1e-5)
+    assert not np.allclose(a[:, -1], b[:, -1])
+
+
+def test_zero3_trajectory_parity():
+    base, _, _, _ = _run(None)
+    got, model, step, _ = _run(dist.ShardingStage3)
+    assert base[0] > base[-1]  # actually training
+    np.testing.assert_allclose(got, base, rtol=2e-4, atol=2e-5)
+    # stage-3: dim-0-shardable params are physically 1/8 per device
+    sharded = [p for p in model.parameters()
+               if p._value.ndim >= 1 and p._value.shape[0] % 8 == 0]
+    assert sharded
+    for p in sharded:
+        sh = p._value.addressable_shards[0]
+        assert abs(sh.data.size / p._value.size - 1 / 8) < 1e-9
+
+
+def test_zero3_hlo_has_sharded_params():
+    """Stage-3's extra sharding vs stage-2 is exactly the parameter inputs
+    (both shard grads + opt state; only stage-3 shards params), so the lowered
+    program must carry strictly more sharding annotations — the gather-on-use
+    lives inside GSPMD, not in eager python."""
+    mesh = dist.auto_mesh(8, dim_names=["dp"])
+    prev = dist.get_mesh()
+    dist.set_mesh(mesh)
+    try:
+        def n_sharding_ops(stage_cls):
+            paddle.seed(0)
+            cfg = llama_tiny()
+            model = LlamaForCausalLM(cfg)
+            opt = dist.shard_optimizer(
+                paddle.optimizer.AdamW(learning_rate=1e-3,
+                                       parameters=model.parameters()),
+                stage_cls("dp", mesh))
+            step = TrainStep(model, lambda logits, loss: loss, opt)
+            x, y = _data(cfg)
+            txt = step.lowered(paddle.to_tensor(x),
+                               labels=paddle.to_tensor(y)).as_text()
+            return (txt.count("sdy.sharding") + txt.count("mhlo.sharding"))
+
+        assert n_sharding_ops(dist.ShardingStage3) > n_sharding_ops(dist.ShardingStage2)
+    finally:
+        dist.set_mesh(prev)
