@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Serving e2e-overhead decomposition (VERDICT r4 item 1): where does the gap
+between the decode-scan rate and end-to-end generate() go?
+
+Round-5 findings this script produced (docs/PERF.md "Decoding round 5"):
+  * per-call KV-cache jnp.zeros dispatches cost ~1.4 s/call through the
+    tunnel — fixed by materializing caches inside the jitted program;
+  * the first back-to-back dispatch burst after compile pays a one-time
+    ~1.2 s tunnel buffer-pool penalty — benches must discard one window.
+
+Phases:
+  1. e2e generate() as bench.py calls it
+  2. the compiled run(state, prompt, key) with pre-built args, 3 bursts
+     (burst 0 shows the one-time penalty)
+  3. host-side arg flatten cost
+  4. prefill-only cost (the non-scan part of each call)
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    B = int(os.environ.get("DBG_B", 1))
+    P, NEW = 128, 128
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
+                    num_heads=16, use_rope=True, use_rms_norm=True,
+                    use_swiglu=True)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+
+    ids_np = np.random.randint(0, 50304, (B, P)).astype(np.int64)
+    ids = paddle.to_tensor(ids_np)
+
+    # ---- 1: e2e generate() exactly as bench.py calls it
+    r = model.generate(ids, max_new_tokens=NEW)
+    np.asarray(r._value[0, -1:])
+    reps = 3
+    for trial in range(3):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            r = model.generate(ids, max_new_tokens=NEW)
+        np.asarray(r._value[:, -1])
+        e2e = (time.perf_counter() - t0) / reps
+        print(f"1.{trial} e2e generate():   {e2e*1e3:8.1f} ms/call  "
+              f"{B*NEW/e2e:7.1f} tok/s")
+
+    # ---- 2: the compiled run — caches live IN-program since round 5, so
+    # its args are just (state, prompt, key)
+    state = model._decode_state(jnp.bfloat16)
+    run = model.compiled_generate_runner(B, P, NEW)
+    key = jax.random.key(0)
+    ids_j = ids._value
+
+    out = run(state, ids_j, key)
+    out.block_until_ready()
+    for trial in range(3):  # burst 0 pays the one-time tunnel penalty
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = run(state, ids_j, key)
+        np.asarray(out[:, -1])
+        bare = (time.perf_counter() - t0) / reps
+        print(f"2.{trial} bare run:         {bare*1e3:8.1f} ms/call  "
+              f"{B*NEW/bare:7.1f} tok/s")
+
+    # ---- 3: host-side arg flatten cost
+    t0 = time.perf_counter()
+    for _ in range(100):
+        jax.tree_util.tree_flatten((state, ids_j, key))
+    flat = (time.perf_counter() - t0) / 100
+    print(f"3 tree_flatten/call:    {flat*1e3:8.1f} ms")
+
+    # ---- 4: prefill-only cost
+    from paddle_tpu.tensor import Tensor as _T
+
+    max_len = P + NEW
+    kv_h, hd = cfg.num_kv_heads, cfg.hidden_size // cfg.num_heads
+    caches = [(jnp.zeros((B, max_len, kv_h, hd), jnp.bfloat16),
+               jnp.zeros((B, max_len, kv_h, hd), jnp.bfloat16))
+              for _ in range(cfg.num_layers)]
+
+    @jax.jit
+    def prefill_only(st, prompt, caches):
+        out = model.gpt.functional_call(
+            st, _T(prompt), caches=[(_T(k), _T(v)) for k, v in caches],
+            cache_offset=jnp.int32(0))
+        lg, _ = out
+        return lg._value[:, -1]
+
+    lg = prefill_only(state, ids_j, caches)
+    lg.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        lg = prefill_only(state, ids_j, caches)
+    np.asarray(lg[:, -1])
+    pf = (time.perf_counter() - t0) / reps
+    print(f"4 prefill only:         {pf*1e3:8.1f} ms/call")
+
+
+if __name__ == "__main__":
+    main()

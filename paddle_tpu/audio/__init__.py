@@ -1,4 +1,8 @@
-"""paddle.audio surface. Reference: python/paddle/audio/__init__.py."""
+"""paddle.audio surface. Reference: python/paddle/audio/__init__.py
+(__all__: backends, datasets, features, functional, info, load, save)."""
 from . import functional  # noqa: F401
 from . import features  # noqa: F401
+from . import backends  # noqa: F401
+from . import datasets  # noqa: F401
+from .backends import info, load, save  # noqa: F401
 from .features import MFCC, LogMelSpectrogram, MelSpectrogram, Spectrogram  # noqa: F401

@@ -1,0 +1,107 @@
+"""Audio IO backends. Reference: python/paddle/audio/backends/
+(init_backend.py registry + wave_backend.py stdlib-wave PCM16 io).
+
+Only the 'wave' backend ships (the reference's default without paddleaudio
+installed — wave_backend.py:95); the registry mirrors the reference so
+`set_backend('soundfile')` fails the same way it does there without the
+optional package.
+"""
+from __future__ import annotations
+
+import wave
+
+import numpy as np
+
+
+class AudioInfo:
+    """Reference: backends/backend.py AudioInfo."""
+
+    def __init__(self, sample_rate, num_samples, num_channels, bits_per_sample,
+                 encoding):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+
+_BACKEND = "wave"
+
+
+def list_available_backends():
+    """Reference init_backend.py:38 — paddleaudio isn't shipped, so: wave."""
+    return ["wave"]
+
+
+def get_current_backend():
+    return _BACKEND
+
+
+def set_backend(backend_name):
+    """Reference init_backend.py:140."""
+    global _BACKEND
+    if backend_name not in list_available_backends():
+        raise NotImplementedError(
+            f"backend {backend_name!r} unavailable — only {list_available_backends()} "
+            "ship here (the reference gets more via the optional paddleaudio wheel)")
+    _BACKEND = backend_name
+
+
+def info(filepath):
+    """Reference wave_backend.py:43 — PCM16 WAV header info."""
+    with wave.open(filepath, "rb") as f:
+        return AudioInfo(f.getframerate(), f.getnframes(), f.getnchannels(),
+                         f.getsampwidth() * 8, "PCM_S")
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """Reference wave_backend.py:95 — PCM16 WAV only; normalize → float32 in
+    (-1, 1); returns (Tensor [C, T] if channels_first, sample_rate)."""
+    from ..tensor import Tensor
+
+    file_obj = filepath if hasattr(filepath, "read") else open(filepath, "rb")
+    try:
+        f = wave.open(file_obj)
+    except wave.Error:
+        file_obj.close()
+        raise NotImplementedError(
+            "only PCM16 WAV is supported by the wave backend")
+    channels = f.getnchannels()
+    sample_rate = f.getframerate()
+    frames = f.getnframes()
+    content = f.readframes(frames)
+    file_obj.close()
+
+    audio = np.frombuffer(content, dtype=np.int16).astype(np.float32)
+    if normalize:
+        audio = audio / 2.0 ** 15
+    waveform = np.reshape(audio, (frames, channels))
+    end = None if num_frames == -1 else frame_offset + num_frames
+    waveform = waveform[frame_offset:end, :]
+    if channels_first:
+        waveform = waveform.T
+    import jax.numpy as jnp
+
+    return Tensor(jnp.asarray(waveform)), sample_rate
+
+
+def save(filepath, src, sample_rate, channels_first=True, encoding="PCM_S",
+         bits_per_sample=16):
+    """Reference wave_backend.py:174 — float (-1,1) [C,T] → PCM16 WAV."""
+    from ..tensor import Tensor
+
+    data = np.asarray(src._value if isinstance(src, Tensor) else src)
+    if data.ndim == 1:
+        data = data[:, None]  # mono → (T, 1) regardless of channels_first
+    elif channels_first:
+        data = data.T  # → (T, C)
+    if bits_per_sample != 16:
+        raise ValueError("wave backend writes PCM16 only")
+    pcm = np.clip(data, -1.0, 1.0)
+    pcm = (pcm * (2 ** 15 - 1)).astype("<i2")
+    with wave.open(filepath, "wb") as f:
+        f.setnchannels(data.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(pcm.tobytes())

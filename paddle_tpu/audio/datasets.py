@@ -1,0 +1,171 @@
+"""Audio classification datasets. Reference: python/paddle/audio/datasets/
+(dataset.py AudioClassificationDataset, esc50.py ESC50, tess.py TESS).
+
+Zero-egress policy (same as vision/datasets): the archive is never fetched;
+point `data_home` (or the PADDLE_TPU_DATA_HOME env var) at an
+already-downloaded extraction. Layouts expected:
+  ESC50: <data_home>/ESC-50-master/{meta/esc50.csv, audio/*.wav}
+  TESS:  <data_home>/TESS_Toronto_emotional_speech_set/**/<spk>_<word>_<emotion>.wav
+"""
+from __future__ import annotations
+
+import os
+
+from ..io import Dataset
+from . import backends
+from .features import MFCC, LogMelSpectrogram, MelSpectrogram, Spectrogram
+
+feat_funcs = {
+    "raw": None,
+    "melspectrogram": MelSpectrogram,
+    "mfcc": MFCC,
+    "logmelspectrogram": LogMelSpectrogram,
+    "spectrogram": Spectrogram,
+}
+
+
+def _data_home(data_home):
+    home = data_home or os.environ.get("PADDLE_TPU_DATA_HOME")
+    if home is None:
+        raise RuntimeError(
+            "no network egress: download is disabled. Pass data_home= (or set "
+            "PADDLE_TPU_DATA_HOME) to the directory holding the extracted "
+            "archive — see paddle_tpu/audio/datasets.py docstring for layout")
+    return home
+
+
+class AudioClassificationDataset(Dataset):
+    """Reference datasets/dataset.py:30 — (feature, label) pairs over wav
+    files, with the feature extractor chosen by feat_type."""
+
+    def __init__(self, files, labels, feat_type="raw", sample_rate=None,
+                 **kwargs):
+        super().__init__()
+        if feat_type not in feat_funcs:
+            raise RuntimeError(
+                f"Unknown feat_type: {feat_type}, must be one of "
+                f"{list(feat_funcs)}")
+        self.files = files
+        self.labels = labels
+        self.feat_type = feat_type
+        self.sample_rate = sample_rate
+        self.feat_config = kwargs
+        self._extractors = {}  # keyed by sample rate: the mel filterbank and
+        #                        jit trace are built once, not per item
+
+    def _extractor(self, sample_rate):
+        ex = self._extractors.get(sample_rate)
+        if ex is None:
+            feat_func = feat_funcs[self.feat_type]
+            if self.feat_type != "spectrogram":
+                ex = feat_func(sr=sample_rate, **self.feat_config)
+            else:
+                ex = feat_func(**self.feat_config)
+            self._extractors[sample_rate] = ex
+        return ex
+
+    def _convert_to_record(self, idx):
+        file, label = self.files[idx], self.labels[idx]
+        waveform, sample_rate = backends.load(file)
+        self.sample_rate = sample_rate
+        v = waveform._value
+        if v.ndim == 2:
+            v = v[0]  # mono view, [T]
+        from ..tensor import Tensor
+
+        if feat_funcs[self.feat_type] is None:
+            return Tensor(v), label
+        x = Tensor(v[None, :])  # (batch, T)
+        return self._extractor(sample_rate)(x).squeeze(0), label
+
+    def __getitem__(self, idx):
+        return self._convert_to_record(idx)
+
+    def __len__(self):
+        return len(self.files)
+
+
+class ESC50(AudioClassificationDataset):
+    """Reference datasets/esc50.py — 2000 5-second environmental recordings,
+    50 classes, 5 predefined folds (meta/esc50.csv column `fold`); `split`
+    selects the held-out fold."""
+
+    label_list = [
+        "Dog", "Rooster", "Pig", "Cow", "Frog", "Cat", "Hen",
+        "Insects (flying)", "Sheep", "Crow",
+        "Rain", "Sea waves", "Crackling fire", "Crickets", "Chirping birds",
+        "Water drops", "Wind", "Pouring water", "Toilet flush", "Thunderstorm",
+        "Crying baby", "Sneezing", "Clapping", "Breathing", "Coughing",
+        "Footsteps", "Laughing", "Brushing teeth", "Snoring",
+        "Drinking, sipping",
+        "Door knock", "Mouse click", "Keyboard typing", "Door, wood creaks",
+        "Can opening", "Washing machine", "Vacuum cleaner", "Clock alarm",
+        "Clock tick", "Glass breaking",
+        "Helicopter", "Chainsaw", "Siren", "Car horn", "Engine", "Train",
+        "Church bells", "Airplane", "Fireworks", "Hand saw",
+    ]
+    meta = os.path.join("ESC-50-master", "meta", "esc50.csv")
+    audio_path = os.path.join("ESC-50-master", "audio")
+
+    def __init__(self, mode="train", split=1, feat_type="raw", data_home=None,
+                 **kwargs):
+        assert split in range(1, 6), f"1 <= split <= 5, got {split}"
+        files, labels = self._get_data(mode, split, _data_home(data_home))
+        super().__init__(files=files, labels=labels, feat_type=feat_type,
+                         **kwargs)
+
+    def _get_data(self, mode, split, home):
+        meta_path = os.path.join(home, self.meta)
+        if not os.path.isfile(meta_path):
+            raise FileNotFoundError(
+                f"{meta_path} not found — extract ESC-50-master.zip under "
+                f"{home} (no network egress; download disabled)")
+        files, labels = [], []
+        with open(meta_path) as rf:
+            for line in rf.readlines()[1:]:
+                filename, fold, target = line.strip().split(",")[:3]
+                keep = (int(fold) != split) if mode == "train" else (
+                    int(fold) == split)
+                if keep:
+                    files.append(os.path.join(home, self.audio_path, filename))
+                    labels.append(int(target))
+        return files, labels
+
+
+class TESS(AudioClassificationDataset):
+    """Reference datasets/tess.py — 2800 emotional speech clips named
+    <speaker>_<word>_<emotion>.wav; folds assigned round-robin by index."""
+
+    label_list = ["angry", "disgust", "fear", "happy", "neutral", "ps", "sad"]
+    audio_path = "TESS_Toronto_emotional_speech_set"
+
+    def __init__(self, mode="train", n_folds=5, split=1, feat_type="raw",
+                 data_home=None, **kwargs):
+        assert isinstance(n_folds, int) and n_folds >= 1, n_folds
+        assert split in range(1, n_folds + 1), (split, n_folds)
+        files, labels = self._get_data(mode, n_folds, split,
+                                       _data_home(data_home))
+        super().__init__(files=files, labels=labels, feat_type=feat_type,
+                         **kwargs)
+
+    def _get_data(self, mode, n_folds, split, home):
+        root = os.path.join(home, self.audio_path)
+        if not os.path.isdir(root):
+            raise FileNotFoundError(
+                f"{root} not found — extract the TESS archive under {home} "
+                "(no network egress; download disabled)")
+        wav_files = []
+        for r, _, fs in sorted(os.walk(root)):
+            for f in sorted(fs):
+                if f.endswith(".wav"):
+                    wav_files.append(os.path.join(r, f))
+        files, labels = [], []
+        for idx, path in enumerate(wav_files):
+            emotion = os.path.basename(path)[:-4].split("_")[2]
+            target = self.label_list.index(emotion)
+            fold = idx % n_folds + 1
+            keep = (fold != split) if mode == "train" else (fold == split)
+            if keep:
+                files.append(path)
+                labels.append(target)
+        return files, labels

@@ -8,16 +8,23 @@ from .mesh import (  # noqa: F401
     Partial, Placement, ProcessMesh, Replicate, Shard, auto_mesh, get_mesh, set_mesh,
 )
 from .api import (  # noqa: F401
-    ShardingStage1, ShardingStage2, ShardingStage3, dtensor_from_local, reshard,
-    shard_dataloader, shard_layer, shard_optimizer, shard_scaler, shard_tensor,
-    unshard_dtensor,
+    DistAttr, ReduceType, ShardingStage1, ShardingStage2, ShardingStage3,
+    dtensor_from_fn, dtensor_from_local, reshard, shard_dataloader, shard_layer,
+    shard_optimizer, shard_scaler, shard_tensor, unshard_dtensor,
 )
 from .collective import (  # noqa: F401
     Group, P2POp, ReduceOp, all_gather, all_gather_object, all_reduce, alltoall,
     alltoall_single, barrier, batch_isend_irecv, broadcast, broadcast_object_list,
     destroy_process_group, gather, get_group, irecv, is_available, isend, new_group,
-    recv, reduce, reduce_scatter, scatter, send, wait,
+    recv, reduce, reduce_scatter, scatter, scatter_object_list, send, split, wait,
 )
+from .compat import (  # noqa: F401
+    ParallelMode, get_backend, gloo_barrier, gloo_init_parallel_env, gloo_release,
+)
+from .entry_attr import (  # noqa: F401
+    CountFilterEntry, ProbabilityEntry, ShowClickEntry,
+)
+from . import io  # noqa: F401
 from .parallel import DataParallel  # noqa: F401
 from . import auto_parallel  # noqa: F401
 from .auto_parallel import (  # noqa: F401
@@ -25,6 +32,9 @@ from .auto_parallel import (  # noqa: F401
     PrepareLayerOutput, RowWiseParallel, SequenceParallelBegin,
     SequenceParallelDisable, SequenceParallelEnable, SequenceParallelEnd,
     SplitPoint, Strategy, parallelize, to_static,
+)
+from .auto_parallel.parallelize import (  # noqa: F401
+    ToDistributedConfig, to_distributed,
 )
 from . import context_parallel  # noqa: F401
 from .context_parallel import (  # noqa: F401

@@ -338,3 +338,40 @@ def shard_dataloader(dataloader, meshes=None, shard_dims=None,
     if mesh is None:
         return dataloader
     return _ShardedDataLoader(dataloader, mesh, shard_dims)
+
+
+class ReduceType:
+    """Reference: paddle/phi/common/reduce_type.h (pybind
+    auto_parallel_py.cc:376) — the pending-reduction kind carried by a
+    Partial placement."""
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+class DistAttr:
+    """Reference: auto_parallel/api.py:159 DistAttr(mesh, sharding_specs) —
+    the legacy (mesh, per-dim axis name) spelling of placements."""
+
+    def __init__(self, mesh, sharding_specs):
+        self.process_mesh = mesh
+        self.sharding_specs = list(sharding_specs)
+
+    def placements(self):
+        """One placement per MESH dim: sharding_specs is indexed by TENSOR dim
+        and names the mesh axis that dim is split along."""
+        out = []
+        for axis in self.process_mesh.dim_names:
+            tensor_dim = next((d for d, spec in enumerate(self.sharding_specs)
+                               if spec == axis), None)
+            out.append(Replicate() if tensor_dim is None else Shard(tensor_dim))
+        return out
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    """Reference: auto_parallel/api.py:757 — build via fn, then lay out."""
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)

@@ -352,3 +352,42 @@ def parallelize(model: Layer, optimizer=None, mesh=None, config=None):
             model, optimizer, config["dp_config"].get("sharding_level", 0),
             mesh)
     return model, optimizer
+
+
+class ToDistributedConfig:
+    """Reference: auto_parallel/high_level_api.py ToDistributedConfig —
+    input spec + sequence-parallel hint for to_distributed."""
+
+    def __init__(self):
+        self.input_spec = None
+        self.sequence_parallel = False
+
+
+def to_distributed(model, optimizer, dataloader, device_num, node_num=1,
+                   config=None):
+    """Reference: auto_parallel/high_level_api.py:255 (experimental). Picks a
+    strategy from the device/node shape and converts model/optimizer/loader.
+
+    TPU-native policy (mirrors the reference's intent, not its pattern-match
+    internals): a 1-D dp mesh with ZeRO-2 grad sharding scales memory and
+    rides ICI all-reduces; sequence_parallel=True adds a 'sep' axis when the
+    device count factors. The mesh is installed globally so subsequent
+    TrainStep compiles against it.
+    """
+    import numpy as np
+
+    from ..api import shard_dataloader
+    from ..mesh import ProcessMesh, set_mesh
+
+    seq_par = bool(config is not None
+                   and getattr(config, "sequence_parallel", False))
+    if seq_par and device_num % 2 == 0:
+        mesh = ProcessMesh(
+            np.arange(device_num).reshape(device_num // 2, 2), ["dp", "sep"])
+    else:
+        mesh = ProcessMesh(np.arange(device_num), ["dp"])
+    set_mesh(mesh)
+    if optimizer is not None:
+        optimizer = shard_optimizer(optimizer, ShardingStage2("dp", mesh))
+    loader = shard_dataloader(dataloader, meshes=[mesh], shard_dims="dp")
+    return model, optimizer, loader

@@ -574,3 +574,49 @@ def wait(tensor, group=None, use_calc_stream=True):
     if not _in_trace(tensor._value):
         tensor._value.block_until_ready()
     return tensor
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0, group=None):
+    """Reference: communication/scatter.py:91. Single-controller SPMD: every
+    rank holds the full in_object_list; this process's share is its group
+    rank's entry (rank<0 → coordinator view, takes src's entry)."""
+    g = _get_group(group)
+    if not in_object_list:
+        return out_object_list
+    idx = g.rank if 0 <= g.rank < len(in_object_list) else (
+        g.get_group_rank(src) if src in g.ranks else 0)
+    out_object_list[:] = [in_object_list[idx]]
+    return out_object_list
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Reference: fleet/layers/mpu/mp_ops.py:786 — build-and-apply an
+    mp-sharded embedding / row-parallel / column-parallel layer. TPU-native:
+    constructs the corresponding fleet mpu layer (weights carry 'mp'
+    shardings; GSPMD inserts the collectives the reference issues manually).
+    """
+    from .fleet.meta_parallel import (
+        ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    )
+
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1],
+                                       weight_attr=weight_attr)
+        return layer(x)
+    if operation != "linear":
+        raise ValueError(
+            f"split supports 'linear' or 'embedding', got {operation!r}")
+    if axis == 0:
+        layer = RowParallelLinear(size[0], size[1],
+                                  weight_attr=weight_attr,
+                                  has_bias=bias_attr is not False,
+                                  input_is_parallel=False)
+    elif axis == 1:
+        layer = ColumnParallelLinear(size[0], size[1],
+                                     weight_attr=weight_attr,
+                                     has_bias=bias_attr is not False,
+                                     gather_output=gather_out)
+    else:
+        raise ValueError(f"split axis must be 0 or 1, got {axis}")
+    return layer(x)

@@ -1,0 +1,55 @@
+"""Reference-parity tail: ParallelMode / get_backend / gloo_* shims.
+
+Reference: fleet/base/topology.py:42 (ParallelMode),
+communication/group.py:364 (get_backend),
+parallel_with_gloo.py (gloo_init_parallel_env/barrier/release).
+
+The TPU control plane is the TCP store + XLA collectives; 'gloo' here maps to
+the CPU-host control-plane path init_parallel_env already provides, so the
+gloo entry points are thin delegates, kept so reference launch scripts run.
+"""
+
+
+class ParallelMode:
+    """Reference fleet/base/topology.py:42 — the four hybrid axes."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+def get_backend(group=None):
+    """Reference communication/group.py:364. Backend naming follows the device
+    actually serving collectives: 'xla:tpu' in-trace on TPU, 'gloo' for the
+    CPU host control plane."""
+    import jax
+
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:
+        platform = "cpu"
+    return "gloo" if platform == "cpu" else f"xla:{platform}"
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """Reference parallel_with_gloo.py:42 — host-only (CPU) process group."""
+    import os
+
+    from .env import init_parallel_env
+
+    host, _, port = server_endpoint.rpartition(":")
+    os.environ.setdefault("PADDLE_TRAINER_ID", str(rank_id))
+    os.environ.setdefault("PADDLE_TRAINERS_NUM", str(rank_num))
+    os.environ.setdefault("MASTER_ADDR", host or "127.0.0.1")
+    os.environ.setdefault("MASTER_PORT", port)
+    init_parallel_env()
+
+
+def gloo_barrier():
+    from .collective import barrier
+
+    barrier()
+
+
+def gloo_release():
+    """The store/heartbeat teardown happens at process exit; nothing to hold."""

@@ -1,0 +1,50 @@
+"""paddle.distributed.io — persistables save/load.
+
+Reference: python/paddle/distributed/io.py (save_persistables:387,
+load_persistables:127, is_persistable:352). Those APIs are Program/Executor
+era; here the persistable set IS the layer state dict, so these delegate to
+the state-dict io in framework/io_utils while keeping the reference calling
+convention (executor slot accepted and ignored; a Layer stands in for the
+Program)."""
+import os
+
+from ..nn.layer import Layer
+
+
+def is_persistable(var):
+    """Reference io.py:352. Parameters and registered buffers persist."""
+    if var is None:
+        return False
+    if getattr(var, "persistable", None) is not None:
+        return bool(var.persistable)
+    return hasattr(var, "trainable")  # Parameter
+
+
+def _require_layer(main_program, who):
+    if isinstance(main_program, Layer):
+        return main_program
+    raise ValueError(
+        f"{who}: there is no Program here — pass the Layer whose state "
+        "should be saved/loaded in the main_program slot (the persistable "
+        "set is exactly layer.state_dict())")
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """Reference io.py:387. `executor` is accepted for signature parity."""
+    import paddle_tpu as paddle
+
+    layer = _require_layer(main_program, "save_persistables")
+    path = os.path.join(dirname, filename or "persistables.pdparams")
+    os.makedirs(dirname, exist_ok=True)
+    paddle.save(layer.state_dict(), path)
+    return path
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    """Reference io.py:127."""
+    import paddle_tpu as paddle
+
+    layer = _require_layer(main_program, "load_persistables")
+    path = os.path.join(dirname, filename or "persistables.pdparams")
+    layer.set_state_dict(paddle.load(path))
+    return layer

@@ -5,5 +5,4 @@ from .ops.linalg import (  # noqa: F401
     matrix_rank, multi_dot, norm, pinv, qr, slogdet, solve, svd, triangular_solve,
     vecdot,
 )
-from .ops.linalg import norm as matrix_norm  # noqa: F401
-from .ops.linalg import norm as vector_norm  # noqa: F401
+from .ops.linalg import matrix_norm, vector_norm  # noqa: F401

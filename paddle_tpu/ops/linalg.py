@@ -82,6 +82,63 @@ def norm(x, p=None, axis=None, keepdim=False, name=None):
     return apply_op(f, "norm", x)
 
 
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    """Reference: python/paddle/tensor/linalg.py vector_norm — vector p-norm;
+    axis=None flattens ALL dims (unlike norm's fro default)."""
+    def f(v):
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if ax is None:
+            ax = tuple(range(v.ndim))
+        a = jnp.abs(v)
+        if p == float("inf"):
+            return jnp.max(a, axis=ax, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(a, axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((v != 0).astype(v.dtype), axis=ax, keepdims=keepdim)
+        if p == 2:
+            return jnp.sqrt(jnp.sum(jnp.square(a), axis=ax, keepdims=keepdim))
+        return jnp.power(jnp.sum(jnp.power(a, p), axis=ax, keepdims=keepdim),
+                         1.0 / p)
+
+    return apply_op(f, "vector_norm", x)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    """Reference: python/paddle/tensor/linalg.py matrix_norm — norm over a
+    2-axis slice: 'fro', 'nuc', +-1 (col sums), +-inf (row sums), +-2
+    (extreme singular values)."""
+    if not (isinstance(axis, (list, tuple)) and len(axis) == 2):
+        raise ValueError(f"matrix_norm axis must be 2 axes, got {axis!r}")
+
+    def f(v):
+        ax = tuple(int(a) % v.ndim for a in axis)
+        if p == "fro":
+            return jnp.sqrt(jnp.sum(jnp.square(jnp.abs(v)), axis=ax,
+                                    keepdims=keepdim))
+        # move the matrix axes last for svd/sum-based definitions
+        rest = [d for d in range(v.ndim) if d not in ax]
+        vm = jnp.transpose(v, rest + list(ax))
+        if p == "nuc" or p in (2, -2):
+            s = jnp.linalg.svd(vm, compute_uv=False)
+            r = (jnp.sum(s, axis=-1) if p == "nuc"
+                 else (jnp.max(s, axis=-1) if p == 2 else jnp.min(s, axis=-1)))
+        elif p in (1, -1, float("inf"), float("-inf")):
+            # p=1: max col-sum; p=inf: max row-sum (negatives take min)
+            sum_ax = -2 if p in (1, -1) else -1
+            sums = jnp.sum(jnp.abs(vm), axis=sum_ax)
+            r = jnp.max(sums, axis=-1) if p in (1, float("inf")) \
+                else jnp.min(sums, axis=-1)
+        else:
+            raise ValueError(f"matrix_norm: unsupported p={p!r}")
+        if keepdim:
+            for a in sorted(ax):
+                r = jnp.expand_dims(r, a)
+        return r
+
+    return apply_op(f, "matrix_norm", x)
+
+
 def vecdot(x, y, axis=-1, name=None):
     return apply_op(lambda a, b: jnp.sum(a * b, axis=axis), "vecdot", x, y)
 

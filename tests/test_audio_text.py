@@ -154,3 +154,88 @@ def test_dataset_download_raises(tmp_path):
         paddle.text.UCIHousing(download=True)
     with pytest.raises(ValueError):
         paddle.text.Imdb()
+
+
+# ---- round 5: backends + datasets (VERDICT r4 item 9 / missing #8) ----
+
+def _write_wav(path, seconds=0.05, sr=8000, freq=440.0):
+    import wave as _wave
+
+    t = np.linspace(0, seconds, int(sr * seconds), endpoint=False)
+    pcm = (0.3 * np.sin(2 * np.pi * freq * t) * (2 ** 15 - 1)).astype("<i2")
+    with _wave.open(str(path), "wb") as f:
+        f.setnchannels(1)
+        f.setsampwidth(2)
+        f.setframerate(sr)
+        f.writeframes(pcm.tobytes())
+
+
+def test_wave_backend_roundtrip(tmp_path):
+    audio = paddle.audio
+    assert audio.backends.list_available_backends() == ["wave"]
+    assert audio.backends.get_current_backend() == "wave"
+    with pytest.raises(NotImplementedError):
+        audio.backends.set_backend("soundfile")
+    p = str(tmp_path / "t.wav")
+    wav = paddle.to_tensor(
+        (0.1 * np.sin(np.linspace(0, 20, 400))).astype("float32")[None, :])
+    audio.save(p, wav, 8000)
+    meta = audio.info(p)
+    assert (meta.sample_rate, meta.num_channels, meta.bits_per_sample) == \
+        (8000, 1, 16)
+    back, sr = audio.load(p)
+    assert sr == 8000 and tuple(back.shape) == (1, 400)
+    np.testing.assert_allclose(np.asarray(back._value),
+                               np.asarray(wav._value), atol=2e-4)
+
+
+def _fake_esc50(home, n_per_fold=2):
+    root = home / "ESC-50-master"
+    (root / "meta").mkdir(parents=True)
+    (root / "audio").mkdir()
+    rows = ["filename,fold,target,category,esc10,src_file,take"]
+    i = 0
+    for fold in range(1, 6):
+        for _ in range(n_per_fold):
+            name = f"clip{i}.wav"
+            _write_wav(root / "audio" / name)
+            rows.append(f"{name},{fold},{i % 50},x,False,{i},A")
+            i += 1
+    (root / "meta" / "esc50.csv").write_text("\n".join(rows) + "\n")
+
+
+def test_esc50_dataset(tmp_path):
+    _fake_esc50(tmp_path)
+    ds = paddle.audio.datasets.ESC50(mode="train", split=1,
+                                     data_home=str(tmp_path))
+    dev = paddle.audio.datasets.ESC50(mode="dev", split=1,
+                                      data_home=str(tmp_path))
+    assert len(ds) == 8 and len(dev) == 2  # folds 2-5 train, fold 1 dev
+    feat, label = ds[0]
+    assert feat.ndim == 1 and isinstance(label, int)
+    mf = paddle.audio.datasets.ESC50(mode="dev", split=1, feat_type="mfcc",
+                                     n_mfcc=13, data_home=str(tmp_path))
+    feat, _ = mf[0]
+    assert feat.shape[0] == 13  # [n_mfcc, frames]
+    assert len(paddle.audio.datasets.ESC50.label_list) == 50
+
+
+def test_tess_dataset(tmp_path):
+    root = tmp_path / "TESS_Toronto_emotional_speech_set"
+    root.mkdir()
+    emotions = paddle.audio.datasets.TESS.label_list
+    for i in range(10):
+        _write_wav(root / f"OAF_word{i}_{emotions[i % 7]}.wav")
+    tr = paddle.audio.datasets.TESS(mode="train", n_folds=5, split=1,
+                                    data_home=str(tmp_path))
+    dv = paddle.audio.datasets.TESS(mode="dev", n_folds=5, split=1,
+                                    data_home=str(tmp_path))
+    assert len(tr) == 8 and len(dv) == 2
+    feat, label = tr[0]
+    assert feat.ndim == 1 and 0 <= label < 7
+
+
+def test_audio_dataset_no_egress_message(monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_DATA_HOME", raising=False)
+    with pytest.raises(RuntimeError, match="no network egress"):
+        paddle.audio.datasets.ESC50(data_home=None)

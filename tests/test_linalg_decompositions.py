@@ -97,3 +97,43 @@ def test_triangular_and_cholesky_solve():
     xc = np.asarray(L.cholesky_solve(paddle.to_tensor(b), paddle.to_tensor(lo),
                                      upper=False)._value)
     np.testing.assert_allclose(a @ xc, b, atol=1e-8)
+
+
+def test_vector_norm_semantics():
+    """vector_norm flattens ALL axes when axis=None (reference
+    python/paddle/tensor/linalg.py vector_norm) — NOT fro-of-matrix."""
+    import paddle_tpu.linalg as L
+
+    rs = np.random.RandomState(0)
+    a = rs.randn(3, 4).astype("float32")
+    t = paddle.to_tensor(a)
+    np.testing.assert_allclose(
+        float(L.vector_norm(t, p=1)), np.abs(a).sum(), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(L.vector_norm(t, p=float("inf"))), np.abs(a).max(), rtol=1e-6)
+    got = np.asarray(L.vector_norm(t, p=2, axis=1)._value)
+    np.testing.assert_allclose(got, np.linalg.norm(a, axis=1), rtol=1e-5)
+
+
+def test_matrix_norm_semantics():
+    """matrix_norm defaults to the trailing 2 axes; induced p=1/inf/2 norms
+    match numpy's matrix norms (reference matrix_norm)."""
+    import paddle_tpu.linalg as L
+
+    rs = np.random.RandomState(1)
+    a = rs.randn(2, 3, 4).astype("float32")
+    t = paddle.to_tensor(a)
+    for p in ("fro", 1, np.inf, 2, "nuc", -1, -2):
+        got = np.asarray(L.matrix_norm(t, p=p)._value)
+        want = np.stack([np.linalg.norm(a[i], ord=p) for i in range(2)])
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-5)
+    # keepdim preserves the reduced axes as size-1
+    assert L.matrix_norm(t, p="fro", keepdim=True).shape == [2, 1, 1]
+
+
+def test_default_program_raises_clearly():
+    import paddle_tpu.static as static
+
+    for fn in (static.default_main_program, static.default_startup_program):
+        with pytest.raises(RuntimeError, match="no Program"):
+            fn()
