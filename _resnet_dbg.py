@@ -1,0 +1,236 @@
+#!/usr/bin/env python
+"""ResNet-50 layout experiment (VERDICT r4 item 3): does an end-to-end
+channels-last model (all elementwise/BN/residual work in NHWC, conv in NHWC
+dimension numbers) beat the NCHW model-zoo path?
+
+Pure-jnp replica of the bench's training math (BN train-mode with batch stats,
+relu, residuals, momentum update, CE loss, bf16 activations / f32 params) so
+layout is the ONLY variable.
+"""
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+LAYER_CFG = [3, 4, 6, 3]
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    layout = os.environ.get("DBG_LAYOUT", "NHWC")
+    B = int(os.environ.get("DBG_B", 128))
+    dn = ("NHWC", "HWIO", "NHWC") if layout == "NHWC" else ("NCHW", "OIHW", "NCHW")
+    ca = -1 if layout == "NHWC" else 1  # channel axis
+
+    rs = np.random.RandomState(0)
+    params = {}
+    bufs = {}
+
+    def conv_p(name, cin, cout, k):
+        w = rs.randn(k, k, cin, cout).astype(np.float32) * (2.0 / (k * k * cin)) ** 0.5
+        if layout != "NHWC":
+            w = np.transpose(w, (3, 2, 0, 1))
+        params[name + ".w"] = jnp.asarray(w)
+
+    def bn_p(name, c):
+        params[name + ".g"] = jnp.ones((c,), jnp.float32)
+        params[name + ".b"] = jnp.zeros((c,), jnp.float32)
+        bufs[name + ".m"] = jnp.zeros((c,), jnp.float32)
+        bufs[name + ".v"] = jnp.ones((c,), jnp.float32)
+
+    def make_block(name, cin, width, cout, stride):
+        conv_p(name + ".c1", cin, width, 1)
+        bn_p(name + ".n1", width)
+        conv_p(name + ".c2", width, width, 3)
+        bn_p(name + ".n2", width)
+        conv_p(name + ".c3", width, cout, 1)
+        bn_p(name + ".n3", cout)
+        if stride != 1 or cin != cout:
+            conv_p(name + ".cd", cin, cout, 1)
+            bn_p(name + ".nd", cout)
+
+    conv_p("stem", 3, 64, 7)
+    bn_p("stem_bn", 64)
+    cin = 64
+    for li, blocks in enumerate(LAYER_CFG):
+        width = 64 * 2 ** li
+        cout = width * 4
+        for bi in range(blocks):
+            make_block(f"l{li}b{bi}", cin, width, cout,
+                       2 if (bi == 0 and li > 0) else 1)
+            cin = cout
+    params["fc.w"] = jnp.asarray(rs.randn(2048, 1000).astype(np.float32) * 0.02)
+    params["fc.b"] = jnp.zeros((1000,), jnp.float32)
+
+    def conv(p, x, name, stride=1, pad="SAME"):
+        return jax.lax.conv_general_dilated(
+            x, p[name + ".w"].astype(x.dtype), (stride, stride), pad,
+            dimension_numbers=dn)
+
+    def bn(p, x, name):
+        axes = (0, 1, 2) if layout == "NHWC" else (0, 2, 3)
+        xf = x.astype(jnp.float32)
+        m = jnp.mean(xf, axes)
+        v = jnp.mean(jnp.square(xf), axes) - jnp.square(m)
+        shape = [1] * x.ndim
+        shape[ca] = x.shape[ca]
+        scale = (p[name + ".g"] * jax.lax.rsqrt(v + 1e-5)).reshape(shape)
+        bias = (p[name + ".b"] - m * scale.reshape(-1)).reshape(shape)
+        return (x * scale.astype(x.dtype) + bias.astype(x.dtype))
+
+    def block(p, x, name, stride):
+        idn = x
+        o = jax.nn.relu(bn(p, conv(p, x, name + ".c1"), name + ".n1"))
+        o = jax.nn.relu(bn(p, conv(p, o, name + ".c2", stride), name + ".n2"))
+        o = bn(p, conv(p, o, name + ".c3"), name + ".n3")
+        if name + ".cd.w" in p:
+            idn = bn(p, conv(p, x, name + ".cd", stride), name + ".nd")
+        return jax.nn.relu(o + idn)
+
+    def forward(p, x):
+        x = conv(p, x, "stem", 2)
+        x = jax.nn.relu(bn(p, x, "stem_bn"))
+        wdims = (1, 2) if layout == "NHWC" else (2, 3)
+        window = [1, 1, 1, 1]
+        strides = [1, 1, 1, 1]
+        for d in wdims:
+            window[d] = 3
+            strides[d] = 2
+        x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window, strides,
+                                  "SAME")
+        cin_l = 64
+        for li, blocks_n in enumerate(LAYER_CFG):
+            for bi in range(blocks_n):
+                x = block(p, x, f"l{li}b{bi}",
+                          2 if (bi == 0 and li > 0) else 1)
+        x = jnp.mean(x.astype(jnp.float32), wdims)
+        return x @ p["fc.w"] + p["fc.b"]
+
+    def loss_fn(p, x, y):
+        logits = forward(p, x)
+        lp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(lp, y[:, None], 1))
+
+    opt_mode = os.environ.get("DBG_OPT", "tree")  # tree | flat
+
+    if opt_mode == "flat":
+        # multi-tensor update: ONE fused elementwise pass over a flat f32
+        # buffer instead of ~55 tiny per-weight fusions
+        names = sorted(params)
+        sizes = [int(np.prod(params[n].shape)) for n in names]
+        offs = np.cumsum([0] + sizes)
+
+        @jax.jit
+        def train_step(p, mom_flat, x, y):
+            loss, g = jax.value_and_grad(loss_fn)(p, x, y)
+            import jax.numpy as jnp
+            g_flat = jnp.concatenate([g[n].ravel() for n in names])
+            new_mom = 0.9 * mom_flat + g_flat
+            new_p = {}
+            for n, o, s in zip(names, offs[:-1], sizes):
+                upd = jax.lax.dynamic_slice(new_mom, (int(o),), (s,))
+                new_p[n] = p[n] - 0.1 * upd.reshape(p[n].shape)
+            return loss, new_p, new_mom
+    else:
+        @jax.jit
+        def train_step(p, mom, x, y):
+            loss, g = jax.value_and_grad(loss_fn)(p, x, y)
+            new_mom = jax.tree_util.tree_map(lambda m, gg: 0.9 * m + gg, mom, g)
+            new_p = jax.tree_util.tree_map(lambda pp, m: pp - 0.1 * m, p, new_mom)
+            return loss, new_p, new_mom
+
+    shape = (B, 224, 224, 3) if layout == "NHWC" else (B, 3, 224, 224)
+    x = jnp.asarray(rs.randn(*shape).astype(np.float32)).astype(jnp.bfloat16)
+    y = jnp.asarray(rs.randint(0, 1000, (B,)))
+    if opt_mode == "flat":
+        mom = jnp.zeros((int(sum(int(np.prod(v.shape)) for v in params.values())),),
+                        jnp.float32)
+    else:
+        mom = jax.tree_util.tree_map(jnp.zeros_like, params)
+
+    if os.environ.get("DBG_AUTOLAYOUT"):
+        # let XLA choose INPUT layouts (conv-tiled weights stay conv-tiled
+        # across steps instead of being transposed in and out every step)
+        from jax.experimental.layout import Format, Layout
+
+        auto = Format(Layout.AUTO)
+        jitted = jax.jit(train_step.__wrapped__,
+                         in_shardings=auto, out_shardings=auto)
+        sds = jax.tree_util.tree_map(
+            lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype),
+            (params, mom, x, y))
+        compiled = jitted.lower(*sds).compile()
+        fmts = compiled.input_formats[0]
+        args = jax.tree_util.tree_map(
+            lambda v, f: jax.device_put(v, f), (params, mom, x, y), fmts)
+        params, mom, x, y = args
+        train_step = compiled
+    else:
+        compiled = train_step.lower(params, mom, x, y).compile()
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0]
+    flops = float(cost.get("flops", 0))
+    bytes_ = float(cost.get("bytes accessed", 0))
+    print(f"{layout}: step {flops/1e9:.1f} GFLOP, {bytes_/1e9:.1f} GB")
+
+    loss, params, mom = train_step(params, mom, x, y)
+    float(loss)
+    steps = 20
+    best = None
+    for trial in range(4):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss, params, mom = train_step(params, mom, x, y)
+        float(loss)
+        dt = time.perf_counter() - t0
+        mfu = flops * steps / dt / 197e12
+        ips = B * steps / dt
+        print(f"{layout} trial{trial}: {ips:8.1f} img/s  MFU {mfu*100:.2f}%")
+        best = max(best or 0, mfu)
+    print(f"{layout} best MFU: {best*100:.2f}%")
+
+    if os.environ.get("DBG_PROFILE"):
+        import collections
+        import glob
+        import gzip
+        import json
+        import tempfile
+
+        d = tempfile.mkdtemp()
+        with jax.profiler.trace(d):
+            for _ in range(5):
+                loss, params, mom = train_step(params, mom, x, y)
+            float(loss)
+        tr = sorted(glob.glob(d + "/**/*.trace.json.gz", recursive=True))[-1]
+        events = json.load(gzip.open(tr))["traceEvents"]
+        pids, tids = {}, {}
+        for e in events:
+            if e.get("ph") == "M" and e.get("name") == "process_name":
+                pids[e["pid"]] = e["args"].get("name", "")
+            if e.get("ph") == "M" and e.get("name") == "thread_name":
+                tids[(e["pid"], e["tid"])] = e["args"].get("name", "")
+        dev = [p for p, n in pids.items() if "TPU" in n]
+        xla_tids = {k[1] for k, v in tids.items()
+                    if k[0] in dev and v == "XLA Ops"}
+        agg = collections.Counter()
+        for e in events:
+            if (e.get("ph") == "X" and e.get("pid") in dev
+                    and e.get("tid") in xla_tids):
+                agg[e["name"]] += e.get("dur", 0) / 1e6
+        tot = sum(agg.values())
+        sc = sum(t for n, t in agg.items() if n.startswith("subtract"))
+        print(f"profile: {tot/5*1e3:.1f} ms/step on device; "
+              f"subtract_* (weight update) {sc/5*1e3:.2f} ms/step")
+        for n, t in agg.most_common(10):
+            print(f"{t/5*1e3:7.3f} ms/step  {n[:64]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -33,7 +33,12 @@ def white_list():
 
 
 def black_list():
-    return BLACK_LIST | _amp_state["custom_black_list"]
+    """custom_white_list OVERRIDES the built-in black list (reference
+    amp_lists.py semantics: an op moved to the white list leaves the black
+    one). Lets numerically-internally-safe ops (e.g. batch_norm, whose
+    implementation computes stats in f32 regardless of input dtype) run in
+    low precision when the user opts in."""
+    return (BLACK_LIST - _amp_state["custom_white_list"]) | _amp_state["custom_black_list"]
 
 
 def is_auto_cast_enabled():

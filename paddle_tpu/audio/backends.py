@@ -70,8 +70,13 @@ def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
     channels = f.getnchannels()
     sample_rate = f.getframerate()
     frames = f.getnframes()
+    sampwidth = f.getsampwidth()
     content = f.readframes(frames)
     file_obj.close()
+    if sampwidth != 2:
+        raise NotImplementedError(
+            f"only PCM16 WAV is supported by the wave backend "
+            f"(got {8 * sampwidth}-bit)")
 
     audio = np.frombuffer(content, dtype=np.int16).astype(np.float32)
     if normalize:

@@ -583,6 +583,11 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0, group=None)
     g = _get_group(group)
     if not in_object_list:
         return out_object_list
+    nranks = len(g.ranks) if g.ranks else 1
+    if len(in_object_list) != nranks:
+        raise ValueError(
+            f"scatter_object_list: len(in_object_list)={len(in_object_list)} "
+            f"must equal the group size {nranks}")
     idx = g.rank if 0 <= g.rank < len(in_object_list) else (
         g.get_group_rank(src) if src in g.ranks else 0)
     out_object_list[:] = [in_object_list[idx]]

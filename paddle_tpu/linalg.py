@@ -1,8 +1,12 @@
-"""paddle.linalg namespace. Reference: python/paddle/linalg.py (38 exports)."""
-from .ops.linalg import (  # noqa: F401
-    cholesky, cholesky_solve, cond, corrcoef, cov, det, eig, eigh, eigvals, eigvalsh,
-    householder_product, inverse as inv, lstsq, lu, matmul, matrix_exp, matrix_power,
-    matrix_rank, multi_dot, norm, pinv, qr, slogdet, solve, svd, triangular_solve,
-    vecdot,
-)
+"""paddle.linalg namespace. Reference: python/paddle/linalg.py (38 exports).
+
+Complete re-export of ops.linalg (importing this module rebinds the package
+attribute `paddle_tpu.linalg` away from ops.linalg, so it must be a superset,
+not a curated subset) plus the paddle-specific aliases (`inv`) and the round-5
+matrix_norm/vector_norm with reference axis/ord semantics."""
+from .ops.linalg import *  # noqa: F401,F403
+from .ops.linalg import __all__ as _ops_all
+from .ops.linalg import inverse as inv  # noqa: F401
 from .ops.linalg import matrix_norm, vector_norm  # noqa: F401
+
+__all__ = sorted(set(_ops_all) | {"inv", "matrix_norm", "vector_norm"})

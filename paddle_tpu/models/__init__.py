@@ -6,3 +6,7 @@ from .gpt import (  # noqa: F401
 from .llama import (  # noqa: F401
     LlamaConfig, LlamaForCausalLM, LlamaModel, llama2_7b, llama_tiny,
 )
+from .bert import (  # noqa: F401
+    BertConfig, BertForMaskedLM, BertModel, bert_base, bert_mlm_mask,
+    bert_tiny, masked_lm_loss,
+)

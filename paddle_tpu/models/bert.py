@@ -1,0 +1,212 @@
+"""BERT/ERNIE-style bidirectional encoder (BASELINE config 3: DP finetune).
+
+The reference repo ships no BERT (PaddleNLP does, out of tree) — this is the
+in-repo reference training script for the masked-LM objective, built TPU-first:
+- non-causal flash attention over [B, S, H, D] (shares ops/pallas path),
+- TP-ready: Column/RowParallelLinear + VocabParallelEmbedding from the fleet
+  mpu layers; weights carry 'mp' shardings when a mesh is set,
+- MLM head ties the word-embedding matrix (standard BERT weight tying),
+- `bert_mlm_mask` implements the 80/10/10 BERT masking recipe host-side.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..distributed.fleet.meta_parallel import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from ..nn import functional as F
+from ..nn.layer import Layer
+from ..nn.layer_common import Dropout, Embedding, LayerList
+from ..nn.layer_conv_norm import LayerNorm
+from ..ops import apply_op
+from ..tensor import Tensor
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden_size=768, num_layers=12,
+                 num_heads=12, intermediate_size=None, max_position=512,
+                 type_vocab_size=2, dropout=0.1, layer_norm_eps=1e-12):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.intermediate_size = intermediate_size or 4 * hidden_size
+        self.max_position = max_position
+        self.type_vocab_size = type_vocab_size
+        self.dropout = dropout
+        self.layer_norm_eps = layer_norm_eps
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        c = config
+        self.word_embeddings = VocabParallelEmbedding(c.vocab_size, c.hidden_size)
+        self.position_embeddings = Embedding(c.max_position, c.hidden_size)
+        self.token_type_embeddings = Embedding(c.type_vocab_size, c.hidden_size)
+        self.layer_norm = LayerNorm(c.hidden_size, epsilon=c.layer_norm_eps)
+        self.dropout = Dropout(c.dropout)
+
+    def forward(self, input_ids, token_type_ids=None):
+        from ..ops.creation import arange, zeros_like
+
+        if input_ids.shape[1] > self.position_embeddings.weight.shape[0]:
+            # JAX's OOB-gather clamping would silently reuse the last
+            # position row past the table (same guard as gpt.py generate)
+            raise ValueError(
+                f"sequence length {input_ids.shape[1]} exceeds max_position "
+                f"{self.position_embeddings.weight.shape[0]}")
+        x = self.word_embeddings(input_ids)
+        x = x + self.position_embeddings(arange(input_ids.shape[1]))
+        if token_type_ids is None:
+            token_type_ids = zeros_like(input_ids)
+        x = x + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(x))
+
+
+class BertSelfAttention(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        c = config
+        self.num_heads = c.num_heads
+        self.head_dim = c.hidden_size // c.num_heads
+        self.qkv = ColumnParallelLinear(c.hidden_size, 3 * c.hidden_size,
+                                        gather_output=False)
+        self.out = RowParallelLinear(c.hidden_size, c.hidden_size,
+                                     input_is_parallel=True)
+        self.dropout = c.dropout
+
+    def forward(self, x, attention_mask=None):
+        B, S = x.shape[0], x.shape[1]
+        qkv = self.qkv(x)
+        h = self.num_heads * self.head_dim
+
+        def split3(v):
+            q = v[..., :h].reshape(B, S, self.num_heads, self.head_dim)
+            k = v[..., h:2 * h].reshape(B, S, self.num_heads, self.head_dim)
+            vv = v[..., 2 * h:].reshape(B, S, self.num_heads, self.head_dim)
+            return q, k, vv
+
+        q, k, v = apply_op(split3, "split_qkv", qkv)
+        if attention_mask is not None:
+            # padding mask path: dense attention with additive bias
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attention_mask, is_causal=False,
+                dropout_p=self.dropout if self.training else 0.0)
+        else:
+            out, _ = F.flash_attention(q, k, v, dropout=self.dropout,
+                                       causal=False, training=self.training)
+        return self.out(out.reshape([B, S, h]))
+
+
+class BertLayer(Layer):
+    """Post-norm (original BERT): ln(x + sublayer(x))."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        c = config
+        self.attention = BertSelfAttention(c)
+        self.ln1 = LayerNorm(c.hidden_size, epsilon=c.layer_norm_eps)
+        self.fc1 = ColumnParallelLinear(c.hidden_size, c.intermediate_size,
+                                        gather_output=False)
+        self.fc2 = RowParallelLinear(c.intermediate_size, c.hidden_size,
+                                     input_is_parallel=True)
+        self.ln2 = LayerNorm(c.hidden_size, epsilon=c.layer_norm_eps)
+        self.dropout = Dropout(c.dropout)
+
+    def forward(self, x, attention_mask=None):
+        x = self.ln1(x + self.dropout(self.attention(x, attention_mask)))
+        x = self.ln2(x + self.dropout(self.fc2(F.gelu(self.fc1(x)))))
+        return x
+
+
+class BertModel(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.layers = LayerList([BertLayer(config)
+                                 for _ in range(config.num_layers)])
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids)
+        for blk in self.layers:
+            x = blk(x, attention_mask)
+        return x
+
+
+class BertForMaskedLM(Layer):
+    """MLM head: transform (dense+gelu+ln) then the tied embedding decoder.
+    Loss ignores positions where labels == ignore_index (-100)."""
+
+    ignore_index = -100
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        c = config
+        self.config = c
+        self.bert = BertModel(c)
+        self.transform = ColumnParallelLinear(c.hidden_size, c.hidden_size)
+        self.transform_ln = LayerNorm(c.hidden_size, epsilon=c.layer_norm_eps)
+
+    def forward(self, input_ids, labels=None, token_type_ids=None,
+                attention_mask=None):
+        h = self.bert(input_ids, token_type_ids, attention_mask)
+        h = self.transform_ln(F.gelu(self.transform(h)))
+        logits = apply_op(lambda hh, w: hh @ w.T, "mlm_decoder", h,
+                          self.bert.embeddings.word_embeddings.weight)
+        if labels is None:
+            return logits
+        return logits, masked_lm_loss(logits, labels)
+
+
+def masked_lm_loss(logits, labels, ignore_index=-100):
+    """Mean NLL over positions where labels != ignore_index; zero (not NaN)
+    when nothing is masked. Standalone so hapi's prepare(loss=...) contract
+    (loss(outputs, labels)) can drive the same objective."""
+
+    def f(lg, lab):
+        lg2 = lg.reshape(-1, lg.shape[-1]).astype(jnp.float32)
+        lab2 = lab.reshape(-1)
+        valid = lab2 != ignore_index
+        safe = jnp.where(valid, lab2, 0)
+        lp = jax.nn.log_softmax(lg2, axis=-1)
+        nll = -jnp.take_along_axis(lp, safe[:, None], 1)[:, 0]
+        nll = jnp.where(valid, nll, 0.0)
+        return jnp.sum(nll) / jnp.maximum(jnp.sum(valid), 1)
+
+    return apply_op(f, "mlm_loss", logits, labels)
+
+
+def bert_mlm_mask(input_ids, vocab_size, mask_token_id, seed=0,
+                  mlm_prob=0.15, special_ids=()):
+    """Host-side BERT masking recipe: select mlm_prob of tokens; of those 80%
+    -> [MASK], 10% -> random token, 10% unchanged. Returns (masked_ids,
+    labels) with labels == -100 on unselected positions."""
+    ids = np.asarray(input_ids._value if isinstance(input_ids, Tensor)
+                     else input_ids)
+    rs = np.random.RandomState(seed)
+    selectable = ~np.isin(ids, list(special_ids))
+    sel = (rs.rand(*ids.shape) < mlm_prob) & selectable
+    labels = np.where(sel, ids, BertForMaskedLM.ignore_index)
+    out = ids.copy()
+    r = rs.rand(*ids.shape)
+    out[sel & (r < 0.8)] = mask_token_id
+    rand_pos = sel & (r >= 0.8) & (r < 0.9)
+    out[rand_pos] = rs.randint(0, vocab_size, rand_pos.sum())
+    return out, labels
+
+
+def bert_base():
+    """BERT-base (BASELINE config 3)."""
+    return BertConfig()
+
+
+def bert_tiny():
+    return BertConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                      num_heads=4, max_position=128, dropout=0.0)

@@ -19,6 +19,7 @@ __all__ = [
     "lu", "eig", "eigh", "eigvals", "eigvalsh", "svd", "pinv", "solve",
     "triangular_solve", "lstsq", "slogdet", "det", "inverse", "matrix_rank", "cov",
     "corrcoef", "cond", "vecdot", "multi_dot", "householder_product", "matrix_exp",
+    "matrix_norm", "vector_norm",
 ]
 
 

@@ -23,15 +23,16 @@ class BasicBlock(Layer):
     expansion = 1
 
     def __init__(self, inplanes, planes, stride=1, downsample=None, groups=1,
-                 base_width=64, dilation=1, norm_layer=None):
+                 base_width=64, dilation=1, norm_layer=None, data_format="NCHW"):
         super().__init__()
         norm_layer = norm_layer or BatchNorm2D
+        df = {"data_format": data_format}
         self.conv1 = Conv2D(inplanes, planes, 3, padding=1, stride=stride,
-                            bias_attr=False)
-        self.bn1 = norm_layer(planes)
+                            bias_attr=False, **df)
+        self.bn1 = norm_layer(planes, **df)
         self.relu = ReLU()
-        self.conv2 = Conv2D(planes, planes, 3, padding=1, bias_attr=False)
-        self.bn2 = norm_layer(planes)
+        self.conv2 = Conv2D(planes, planes, 3, padding=1, bias_attr=False, **df)
+        self.bn2 = norm_layer(planes, **df)
         self.downsample = downsample
         self.stride = stride
 
@@ -48,17 +49,20 @@ class BottleneckBlock(Layer):
     expansion = 4
 
     def __init__(self, inplanes, planes, stride=1, downsample=None, groups=1,
-                 base_width=64, dilation=1, norm_layer=None):
+                 base_width=64, dilation=1, norm_layer=None, data_format="NCHW"):
         super().__init__()
         norm_layer = norm_layer or BatchNorm2D
+        df = {"data_format": data_format}
         width = int(planes * (base_width / 64.0)) * groups
-        self.conv1 = Conv2D(inplanes, width, 1, bias_attr=False)
-        self.bn1 = norm_layer(width)
+        self.conv1 = Conv2D(inplanes, width, 1, bias_attr=False, **df)
+        self.bn1 = norm_layer(width, **df)
         self.conv2 = Conv2D(width, width, 3, padding=dilation, stride=stride,
-                            groups=groups, dilation=dilation, bias_attr=False)
-        self.bn2 = norm_layer(width)
-        self.conv3 = Conv2D(width, planes * self.expansion, 1, bias_attr=False)
-        self.bn3 = norm_layer(planes * self.expansion)
+                            groups=groups, dilation=dilation, bias_attr=False,
+                            **df)
+        self.bn2 = norm_layer(width, **df)
+        self.conv3 = Conv2D(width, planes * self.expansion, 1, bias_attr=False,
+                            **df)
+        self.bn3 = norm_layer(planes * self.expansion, **df)
         self.relu = ReLU()
         self.downsample = downsample
         self.stride = stride
@@ -74,8 +78,14 @@ class BottleneckBlock(Layer):
 
 
 class ResNet(Layer):
+    """`data_format` (TPU extension beyond the reference constructor): "NHWC"
+    builds the whole network channels-last — convs, BN reductions, residual
+    adds and pooling all share the TPU-native minor-most channel layout, worth
+    ~2 MFU points end-to-end at B=128 (docs/PERF.md round-5 layout table).
+    Input must then be NHWC too."""
+
     def __init__(self, block, depth=50, width=64, num_classes=1000, with_pool=True,
-                 groups=1):
+                 groups=1, data_format="NCHW"):
         super().__init__()
         layer_cfg = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
                      101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
@@ -87,36 +97,41 @@ class ResNet(Layer):
         self._norm_layer = BatchNorm2D
         self.inplanes = 64
         self.dilation = 1
+        self.data_format = data_format
+        df = {"data_format": data_format}
 
         self.conv1 = Conv2D(3, self.inplanes, kernel_size=7, stride=2, padding=3,
-                            bias_attr=False)
-        self.bn1 = self._norm_layer(self.inplanes)
+                            bias_attr=False, **df)
+        self.bn1 = self._norm_layer(self.inplanes, **df)
         self.relu = ReLU()
-        self.maxpool = MaxPool2D(kernel_size=3, stride=2, padding=1)
+        self.maxpool = MaxPool2D(kernel_size=3, stride=2, padding=1, **df)
         self.layer1 = self._make_layer(block, 64, layers[0])
         self.layer2 = self._make_layer(block, 128, layers[1], stride=2)
         self.layer3 = self._make_layer(block, 256, layers[2], stride=2)
         self.layer4 = self._make_layer(block, 512, layers[3], stride=2)
         if with_pool:
-            self.avgpool = AdaptiveAvgPool2D((1, 1))
+            self.avgpool = AdaptiveAvgPool2D((1, 1), **df)
         if num_classes > 0:
             self.fc = Linear(512 * block.expansion, num_classes)
 
     def _make_layer(self, block, planes, blocks, stride=1, dilate=False):
         norm_layer = self._norm_layer
         downsample = None
+        df = {"data_format": self.data_format}
         if stride != 1 or self.inplanes != planes * block.expansion:
             downsample = Sequential(
                 Conv2D(self.inplanes, planes * block.expansion, 1, stride=stride,
-                       bias_attr=False),
-                norm_layer(planes * block.expansion),
+                       bias_attr=False, **df),
+                norm_layer(planes * block.expansion, **df),
             )
         layers = [block(self.inplanes, planes, stride, downsample, self.groups,
-                        self.base_width, self.dilation, norm_layer)]
+                        self.base_width, self.dilation, norm_layer,
+                        data_format=self.data_format)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
             layers.append(block(self.inplanes, planes, groups=self.groups,
-                                base_width=self.base_width, norm_layer=norm_layer))
+                                base_width=self.base_width, norm_layer=norm_layer,
+                                data_format=self.data_format))
         return Sequential(*layers)
 
     def forward(self, x):

@@ -40,9 +40,13 @@ def test_dist_attr_placements():
 
 
 def test_scatter_object_list():
+    nranks = len(dist.get_group().ranks) or 1
+    objs = [{"i": i} for i in range(nranks)]
     out = [None]
-    dist.scatter_object_list(out, [{"a": 1}, {"b": 2}], src=0)
-    assert out == [{"a": 1}]  # single-controller rank-0 share
+    dist.scatter_object_list(out, objs, src=0)
+    assert out == [objs[max(0, dist.get_group().rank)]]
+    with pytest.raises(ValueError, match="group size"):
+        dist.scatter_object_list([None], objs + [{"extra": 1}], src=0)
 
 
 def test_split_linear_and_embedding():
