@@ -25,35 +25,56 @@ def topk_capacity_routing(probs, k: int, capacity: int, normalize_topk=True):
     Returns (combine [T,E,C] f32, dispatch [T,E,C] bool, top1_onehot [T,E]).
     Tokens beyond an expert's capacity are dropped (zero contribution), matching
     the reference's capacity semantics (gshard_gate.py / switch_gate.py).
-    """
+
+    Derived from the SAME routing decisions as the index form (one
+    implementation — dense-vs-index parity holds by construction): the dense
+    tensors are a scatter of the flat (eid, loc, keep, gval) indices."""
+    T, E = probs.shape
+    eids, locs, keeps, gvals, top1 = topk_capacity_routing_indices(
+        probs, k, capacity, normalize_topk)
+    combine = jnp.zeros((T, E, capacity), probs.dtype)
+    t_idx = jnp.broadcast_to(jnp.arange(T), eids.shape)
+    # dropped assignments scatter out of bounds -> mode="drop" discards them
+    e_safe = jnp.where(keeps, eids, E)
+    combine = combine.at[t_idx.reshape(-1), e_safe.reshape(-1),
+                         locs.reshape(-1)].add(
+        (gvals * keeps).reshape(-1).astype(probs.dtype), mode="drop")
+    dispatch = combine > 0
+    return combine, dispatch, top1
+
+
+def topk_capacity_routing_indices(probs, k: int, capacity: int,
+                                  normalize_topk=True):
+    """Same routing DECISIONS as topk_capacity_routing, returned as flat
+    indices instead of [T,E,C] one-hot tensors: (eids, locs, keeps, gvals)
+    each [k, T], plus the top-1 one-hot for the balance loss. The index form
+    feeds gather/scatter dispatch — O(k*T*d) instead of the dense einsum's
+    O(T*E*C*d), the MoE-dispatch analog of the reference's fused_moe_kernel
+    (fusion/cutlass/fused_moe_kernel.cu) grouped-GEMM shape."""
     T, E = probs.shape
     masked = probs
-    sel = []  # (gate_val [T], onehot [T,E])
-    for _ in range(k):
+    prev_counts = jnp.zeros((E,), probs.dtype)
+    eids, locs, keeps, gvals = [], [], [], []
+    top1 = None
+    for r in range(k):
         idx = jnp.argmax(masked, axis=1)
         onehot = jax.nn.one_hot(idx, E, dtype=probs.dtype)
+        if r == 0:
+            top1 = onehot
         gval = jnp.sum(probs * onehot, axis=1)
-        sel.append((gval, onehot))
-        masked = masked * (1.0 - onehot)
-    if normalize_topk and k > 1:
-        denom = sum(g for g, _ in sel) + 1e-9
-        sel = [(g / denom, oh) for g, oh in sel]
-
-    combine = jnp.zeros((T, E, capacity), probs.dtype)
-    prev_counts = jnp.zeros((E,), probs.dtype)
-    for gval, onehot in sel:
-        # position of each token inside its chosen expert's buffer, counting
-        # earlier-round assignments first (GShard ordering: all top-1 before top-2)
-        loc_round = jnp.cumsum(onehot, axis=0) - onehot          # [T, E]
+        loc_round = jnp.cumsum(onehot, axis=0) - onehot
         loc = jnp.sum(loc_round * onehot, axis=1) + onehot @ prev_counts
-        keep = (loc < capacity) & (jnp.sum(onehot, axis=1) > 0)
-        loc_oh = jax.nn.one_hot(loc.astype(jnp.int32), capacity, dtype=probs.dtype)
-        combine = combine + (
-            (gval * keep)[:, None, None] * onehot[:, :, None] * loc_oh[:, None, :]
-        )
+        keep = loc < capacity
+        eids.append(idx.astype(jnp.int32))
+        locs.append(loc.astype(jnp.int32))
+        keeps.append(keep)
+        gvals.append(gval)
         prev_counts = prev_counts + jnp.sum(onehot, axis=0)
-    dispatch = combine > 0
-    return combine, dispatch, sel[0][1]
+        masked = masked * (1.0 - onehot)
+    gvals = jnp.stack(gvals)
+    if normalize_topk and k > 1:
+        gvals = gvals / (jnp.sum(gvals, axis=0, keepdims=True) + 1e-9)
+    return (jnp.stack(eids), jnp.stack(locs), jnp.stack(keeps), gvals, top1)
 
 
 def load_balance_loss(probs, top1_onehot):
@@ -102,6 +123,14 @@ class NaiveGate(BaseGate):
         probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
         combine, dispatch, top1 = topk_capacity_routing(probs, self.top_k, capacity)
         return combine, dispatch, load_balance_loss(probs, top1)
+
+    def route_indices(self, logits, capacity):
+        """(eids, locs, keeps, gvals) [k,T] + aux loss — the gather/scatter
+        dispatch form (see topk_capacity_routing_indices)."""
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        eids, locs, keeps, gvals, top1 = topk_capacity_routing_indices(
+            probs, self.top_k, capacity)
+        return eids, locs, keeps, gvals, load_balance_loss(probs, top1)
 
 
 class GShardGate(NaiveGate):

@@ -48,8 +48,18 @@ class MoELayer(Layer):
     """
 
     def __init__(self, d_model, experts, gate=None, moe_group=None, mp_group=None,
-                 recompute_interval=0, **kwargs):
+                 recompute_interval=0, dispatch_mode=None, **kwargs):
         super().__init__()
+        if dispatch_mode not in (None, "index", "dense"):
+            raise ValueError(f"dispatch_mode must be None, 'index' or 'dense', "
+                             f"got {dispatch_mode!r}")
+        # 'index': gather/scatter dispatch+combine, O(k*T*d) — the grouped-GEMM
+        # shape (reference fused_moe_kernel.cu role); 'dense': the one-hot
+        # einsum formulation, O(T*E*C*d), kept as the parity oracle. None
+        # (default): 'index' when the gate provides route_indices, else
+        # 'dense'; an EXPLICIT 'index' with an incapable gate raises rather
+        # than silently running the quadratic path.
+        self._dispatch_mode_arg = dispatch_mode
         self.d_model = d_model
         self.experts = experts if isinstance(experts, LayerList) else LayerList(experts)
         num_expert = len(self.experts)
@@ -63,6 +73,14 @@ class MoELayer(Layer):
         if not isinstance(gate, BaseGate):
             raise TypeError(f"gate must be a BaseGate, got {type(gate)}")
         self.gate = gate
+        gate_has_indices = hasattr(gate, "route_indices")
+        if self._dispatch_mode_arg == "index" and not gate_has_indices:
+            raise ValueError(
+                "dispatch_mode='index' requires the gate to implement "
+                f"route_indices; {type(gate).__name__} does not — pass "
+                "dispatch_mode='dense' or None (auto)")
+        self.dispatch_mode = (self._dispatch_mode_arg
+                              or ("index" if gate_has_indices else "dense"))
         self.recompute_interval = recompute_interval
         self.l_aux = None
         self._uniform = self._check_uniform()
@@ -94,12 +112,29 @@ class MoELayer(Layer):
         gate = self.gate
         recompute = self.recompute_interval > 0
 
+        index_mode = self.dispatch_mode == "index"
+
         def f(xv, gw, *pvals):
             xf = xv.reshape(T, d)
             logits = xf @ gw.astype(xf.dtype)
-            combine, dispatch, l_aux = gate.route(logits, capacity)
-            combine = combine.astype(xf.dtype)
-            disp = jnp.einsum("tec,td->ecd", dispatch.astype(xf.dtype), xf)
+            if index_mode:
+                eids, locs, keeps, gvals, l_aux = gate.route_indices(
+                    logits, capacity)
+                # slot address per (round, token); dropped tokens target the
+                # sentinel slot E*C which backs a zero row
+                slot = jnp.where(keeps, eids * capacity + locs, E * capacity)
+                token_for = jnp.full((E * capacity + 1,), T, jnp.int32)
+                t_idx = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32),
+                                         slot.shape)
+                token_for = token_for.at[slot.reshape(-1)].set(
+                    t_idx.reshape(-1), mode="drop")
+                x_pad = jnp.concatenate([xf, jnp.zeros((1, d), xf.dtype)])
+                disp = x_pad[token_for[:-1]].reshape(E, capacity, d)
+                combine = None
+            else:
+                combine, dispatch, l_aux = gate.route(logits, capacity)
+                combine = combine.astype(xf.dtype)
+                disp = jnp.einsum("tec,td->ecd", dispatch.astype(xf.dtype), xf)
             mesh, ax = _ep_axis()
             if mesh is not None and isinstance(disp, jax.core.Tracer) and E % \
                     mesh.get_dim_size(ax) == 0:
@@ -134,8 +169,16 @@ class MoELayer(Layer):
 
                 eo = jax.lax.with_sharding_constraint(
                     eo, NamedSharding(mesh.jax_mesh, PartitionSpec(ax)))
-            y = jnp.einsum("ecd,tec->td", eo.astype(jnp.float32),
-                           combine.astype(jnp.float32)).astype(xf.dtype)
+            if index_mode:
+                eo_pad = jnp.concatenate(
+                    [eo.reshape(E * capacity, d).astype(jnp.float32),
+                     jnp.zeros((1, d), jnp.float32)])
+                w = (gvals * keeps).astype(jnp.float32)        # [k, T]
+                y = jnp.sum(w[..., None] * eo_pad[slot], axis=0)
+                y = y.astype(xf.dtype)
+            else:
+                y = jnp.einsum("ecd,tec->td", eo.astype(jnp.float32),
+                               combine.astype(jnp.float32)).astype(xf.dtype)
             return y.reshape(orig_shape), l_aux
 
         y, l_aux = apply_op(f, "moe_layer", x, self.gate.weight, *expert_params,
