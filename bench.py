@@ -84,23 +84,16 @@ def _timed_steps(step, args, kwargs, steps, sync_param, windows=3):
     return _median_windows(one_window, windows)
 
 
-def bench_gpt(on_accel, dev):
+def _gpt_train_phase(cfg, B, S, steps, on_accel, dev):
+    """One GPT training measurement: build, AOT-compile, median-of-windows
+    timing, with the full audit set (cost-analysis FLOPs, MFU>100% abort,
+    flash-kernel-in-HLO check) shared by the headline and long_context
+    phases."""
     import paddle_tpu as paddle
     from paddle_tpu.jit.train import TrainStep
-    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.models.gpt import GPTForCausalLM
 
     paddle.seed(0)
-    if on_accel:
-        # ~350M params (GPT-medium class): fits one v5e chip with Adam state
-        cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
-                        num_heads=16, max_position=1024, use_rope=True,
-                        use_rms_norm=True, use_swiglu=True)
-        B, S, steps = 8, 1024, 20
-    else:
-        cfg = GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
-                        num_heads=4, max_position=128)
-        B, S, steps = 2, 64, 2
-
     model = GPTForCausalLM(cfg)
     if on_accel:
         paddle.amp.decorate(model, level="O2", dtype="bfloat16")
@@ -122,8 +115,6 @@ def bench_gpt(on_accel, dev):
     small_param = min(model.parameters(), key=lambda t: t.size)
     dt, loss, wins = _timed_steps(step, (x,), {"labels": y}, steps, small_param,
                                   windows=3 if on_accel else 1)
-    tokens_per_sec = B * S * steps / dt
-
     peak = _chip_peak(dev) if on_accel else None
     mfu = None
     audit = "ok"
@@ -132,9 +123,9 @@ def bench_gpt(on_accel, dev):
     elif peak:
         mfu = flops * steps / dt / peak
         if mfu > 1.0:
-            return None, {"error": f"GPT MFU {mfu:.2f} > 100% — timing broken"}
-    result = {
-        "tokens_per_sec": round(tokens_per_sec, 1),
+            raise RuntimeError(f"MFU {mfu:.2f} > 100% — timing broken")
+    return {
+        "tokens_per_sec": round(B * S * steps / dt, 1),
         "mfu": round(mfu, 4) if mfu is not None else None,
         "audit": audit,
         "step_gflops": round(flops / 1e9, 1),
@@ -144,7 +135,34 @@ def bench_gpt(on_accel, dev):
         "windows_sec": wins,           # sorted per-window wall (spread audit)
         "config": {"block_q": "adaptive", "recompute": cfg.recompute},
     }
-    return result, None
+
+
+def _gpt350m_cfg(max_position=1024):
+    """The ONE GPT-350M (GPT-medium class) config every phase measures —
+    headline, serving and long_context stay comparable by construction."""
+    from paddle_tpu.models.gpt import GPTConfig
+
+    return GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
+                     num_heads=16, max_position=max_position, use_rope=True,
+                     use_rms_norm=True, use_swiglu=True)
+
+
+def _gpt_smoke_cfg(max_position=128):
+    from paddle_tpu.models.gpt import GPTConfig
+
+    return GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                     num_heads=4, max_position=max_position)
+
+
+def bench_gpt(on_accel, dev):
+    if on_accel:
+        cfg, B, S, steps = _gpt350m_cfg(), 8, 1024, 20
+    else:
+        cfg, B, S, steps = _gpt_smoke_cfg(), 2, 64, 2
+    try:
+        return _gpt_train_phase(cfg, B, S, steps, on_accel, dev), None
+    except RuntimeError as e:
+        return None, {"error": f"GPT {e}"}
 
 
 def bench_serving(on_accel, dev):
@@ -153,18 +171,13 @@ def bench_serving(on_accel, dev):
     import time
 
     import paddle_tpu as paddle
-    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.models.gpt import GPTForCausalLM
 
     paddle.seed(0)
     if on_accel:
-        cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
-                        num_heads=16, max_position=1024, use_rope=True,
-                        use_rms_norm=True, use_swiglu=True)
-        P, NEW = 128, 128
+        cfg, P, NEW = _gpt350m_cfg(), 128, 128
     else:
-        cfg = GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
-                        num_heads=4, max_position=256)
-        P, NEW = 16, 16
+        cfg, P, NEW = _gpt_smoke_cfg(max_position=256), 16, 16
     model = GPTForCausalLM(cfg)
     model.eval()
     out = {}
@@ -207,6 +220,67 @@ def bench_serving(on_accel, dev):
         scan, _, _ = _median_windows(scan_window, windows)
         out[f"b{B}_scan_tokens_per_sec"] = round(B * NEW / scan, 1)
     out.update(prompt=P, new_tokens=NEW, decode_dtype="bfloat16")
+    return out, None
+
+
+def _long_context_impl(on_accel, dev):
+    """Long-sequence training evidence (VERDICT r4 item 8): GPT-350M train
+    step at S=4096 and S=8192 on one chip — the flash kernel's adaptive
+    q-block (512 / 256 at these S, ops/pallas/flash_attention.py) keeps the
+    S^2 score tile inside VMEM; ring attention extends past the single-chip
+    cap via the sep axis (dryrun leg in __graft_entry__.py). Shares
+    _gpt_train_phase with the headline bench, audits included."""
+    import gc
+
+    import jax
+
+    out = {}
+    shapes = ((4096, 2), (8192, 1)) if on_accel else ((256, 1),)
+    for S, B in shapes:
+        cfg = (_gpt350m_cfg(max_position=S) if on_accel
+               else _gpt_smoke_cfg(max_position=S))
+        try:
+            r = _gpt_train_phase(cfg, B, S, 8 if on_accel else 1,
+                                 on_accel, dev)
+            out[f"s{S}"] = {k: r[k] for k in
+                            ("tokens_per_sec", "mfu", "audit",
+                             "flash_kernel_in_hlo", "batch", "windows_sec")}
+        except Exception as e:
+            # keep the shapes that DID measure; a later-S failure must not
+            # discard a finished multi-minute result
+            out[f"s{S}"] = {"error": repr(e)[:300]}
+        gc.collect()
+        try:
+            jax.clear_caches()
+        except Exception:
+            pass
+    return out
+
+
+def bench_long_context(on_accel, dev):
+    """Runs the long-context phase in a FRESH subprocess: the S=4096/8192
+    compiles are the largest in the bench and the tunnel's remote-compile
+    helper can 500 when asked for them after the GPT+serving phases have
+    filled it (observed; standalone the same compile succeeds). Falls back
+    to in-process on subprocess failure."""
+    import subprocess
+
+    me = os.path.abspath(__file__)
+    try:
+        proc = subprocess.run([sys.executable, me, "--long-context"],
+                              capture_output=True, text=True, timeout=1800)
+        for line in reversed(proc.stdout.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                return json.loads(line), None
+        sub_err = (f"subprocess rc={proc.returncode}: "
+                   f"{proc.stderr.strip()[-300:]}")
+    except Exception as e:
+        sub_err = repr(e)[:300]
+    # in-process fallback (per-shape errors are isolated inside); keep the
+    # subprocess failure reason in the report instead of discarding it
+    out = _long_context_impl(on_accel, dev)
+    out["subprocess_error"] = sub_err
     return out, None
 
 
@@ -301,6 +375,15 @@ def main():
     except Exception:
         pass
     try:
+        long_ctx, long_ctx_err = bench_long_context(on_accel, dev)
+    except Exception as e:
+        long_ctx, long_ctx_err = None, {"error": repr(e)[:200]}
+    gc.collect()
+    try:
+        jax.clear_caches()
+    except Exception:
+        pass
+    try:
         resnet, resnet_err = bench_resnet(on_accel, dev)
     except Exception as e:  # resnet must not sink the GPT headline
         resnet, resnet_err = None, {"error": repr(e)[:200]}
@@ -316,6 +399,7 @@ def main():
             "audit": gpt["audit"],
             "gpt": gpt,
             "serving": serving if serving is not None else serving_err,
+            "long_context": long_ctx if long_ctx is not None else long_ctx_err,
             "resnet50": resnet if resnet is not None else resnet_err,
             "device": getattr(dev, "device_kind", dev.platform),
         }
@@ -333,4 +417,11 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--long-context" in sys.argv:
+        import jax
+
+        _dev = jax.devices()[0]
+        print(json.dumps(_long_context_impl(
+            _dev.platform not in ("cpu",), _dev)))
+    else:
+        main()

@@ -59,7 +59,6 @@ class MoELayer(Layer):
         # (default): 'index' when the gate provides route_indices, else
         # 'dense'; an EXPLICIT 'index' with an incapable gate raises rather
         # than silently running the quadratic path.
-        self._dispatch_mode_arg = dispatch_mode
         self.d_model = d_model
         self.experts = experts if isinstance(experts, LayerList) else LayerList(experts)
         num_expert = len(self.experts)
@@ -74,12 +73,12 @@ class MoELayer(Layer):
             raise TypeError(f"gate must be a BaseGate, got {type(gate)}")
         self.gate = gate
         gate_has_indices = hasattr(gate, "route_indices")
-        if self._dispatch_mode_arg == "index" and not gate_has_indices:
+        if dispatch_mode == "index" and not gate_has_indices:
             raise ValueError(
                 "dispatch_mode='index' requires the gate to implement "
                 f"route_indices; {type(gate).__name__} does not — pass "
                 "dispatch_mode='dense' or None (auto)")
-        self.dispatch_mode = (self._dispatch_mode_arg
+        self.dispatch_mode = (dispatch_mode
                               or ("index" if gate_has_indices else "dense"))
         self.recompute_interval = recompute_interval
         self.l_aux = None

@@ -225,8 +225,151 @@ def _dkv_kernel(*refs, scale, causal, block_k, seq, has_sri):
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
+def _dq_kernel_chunked(*refs, scale, causal, block_q, block_kc, seq):
+    """dq for one q block, accumulated over k/v CHUNKS via the innermost grid
+    dim — every tile is [block_q, block_kc], so VMEM stack use is independent
+    of S (the full-sequence variant holds [block, S] f32 tiles and blows the
+    16 MiB scoped limit at S=8192)."""
+    q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref = refs
+    qi = pl.program_id(1)
+    kc = pl.program_id(2)
+
+    @pl.when(kc == 0)
+    def _init():
+        dq_ref[0] = jnp.zeros_like(dq_ref[0])
+
+    row0 = qi * block_q
+    col0 = kc * block_kc
+    # causal: a chunk strictly above the diagonal contributes nothing
+    live = jnp.logical_or(not causal, col0 <= row0 + block_q - 1)
+
+    @pl.when(live)
+    def _body():
+        scale32 = jnp.float32(scale)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]
+        delta = dl_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale32
+        rows = row0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = col0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        allowed = _allowed_mask(rows, cols, None, causal, seq)
+        s = jnp.where(allowed, s, jnp.float32(_NEG))
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale32
+        dq_ref[0] += jax.lax.dot_general(
+            ds.astype(q.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+
+def _dkv_kernel_chunked(*refs, scale, causal, block_k, block_qc, seq):
+    """dk/dv for one k block, accumulated over q/do CHUNKS (see
+    _dq_kernel_chunked)."""
+    q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dk_ref, dv_ref = refs
+    ki = pl.program_id(1)
+    qc = pl.program_id(2)
+
+    @pl.when(qc == 0)
+    def _init():
+        dk_ref[0] = jnp.zeros_like(dk_ref[0])
+        dv_ref[0] = jnp.zeros_like(dv_ref[0])
+
+    row0 = qc * block_qc
+    col0 = ki * block_k
+    live = jnp.logical_or(not causal, col0 <= row0 + block_qc - 1)
+
+    @pl.when(live)
+    def _body():
+        scale32 = jnp.float32(scale)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]
+        delta = dl_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale32
+        rows = row0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = col0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        allowed = _allowed_mask(rows, cols, None, causal, seq)
+        s = jnp.where(allowed, s, jnp.float32(_NEG))
+        p = jnp.exp(s - lse)                                     # (QC, BK)
+        dv_ref[0] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale32
+        dk_ref[0] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+
+def _mha_bwd_chunked(q, k, v, out, lse, g, causal, scale):
+    """Backward via chunk-accumulating kernels: VMEM-safe at any S (tiles are
+    [512, 512] f32 regardless of sequence). Accumulation is f32 (the outputs
+    are f32 and cast once at the end — bf16 += over S/512 chunks would lose
+    precision)."""
+    bh, seq, d = q.shape
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    lse = lse.reshape(bh, seq, 1)
+    delta = delta.reshape(bh, seq, 1)
+    blk = 512
+    n = seq // blk
+    with _no_x64():
+        dq = pl.pallas_call(
+            functools.partial(_dq_kernel_chunked, scale=scale, causal=causal,
+                              block_q=blk, block_kc=blk, seq=seq),
+            grid=(bh, n, n),
+            in_specs=[
+                pl.BlockSpec((1, blk, d), lambda b, i, j: (b, i, 0)),   # q
+                pl.BlockSpec((1, blk, d), lambda b, i, j: (b, j, 0)),   # k
+                pl.BlockSpec((1, blk, d), lambda b, i, j: (b, j, 0)),   # v
+                pl.BlockSpec((1, blk, d), lambda b, i, j: (b, i, 0)),   # do
+                pl.BlockSpec((1, blk, 1), lambda b, i, j: (b, i, 0)),   # lse
+                pl.BlockSpec((1, blk, 1), lambda b, i, j: (b, i, 0)),   # delta
+            ],
+            out_specs=pl.BlockSpec((1, blk, d), lambda b, i, j: (b, i, 0)),
+            out_shape=jax.ShapeDtypeStruct(q.shape, jnp.float32),
+            interpret=_interpret(),
+        )(q, k, v, g, lse, delta)
+        dk, dv = pl.pallas_call(
+            functools.partial(_dkv_kernel_chunked, scale=scale, causal=causal,
+                              block_k=blk, block_qc=blk, seq=seq),
+            grid=(bh, n, n),
+            in_specs=[
+                pl.BlockSpec((1, blk, d), lambda b, i, j: (b, j, 0)),   # q
+                pl.BlockSpec((1, blk, d), lambda b, i, j: (b, i, 0)),   # k
+                pl.BlockSpec((1, blk, d), lambda b, i, j: (b, i, 0)),   # v
+                pl.BlockSpec((1, blk, d), lambda b, i, j: (b, j, 0)),   # do
+                pl.BlockSpec((1, blk, 1), lambda b, i, j: (b, j, 0)),   # lse
+                pl.BlockSpec((1, blk, 1), lambda b, i, j: (b, j, 0)),   # delta
+            ],
+            out_specs=[
+                pl.BlockSpec((1, blk, d), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, blk, d), lambda b, i, j: (b, i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct(k.shape, jnp.float32),
+                jax.ShapeDtypeStruct(v.shape, jnp.float32),
+            ],
+            interpret=_interpret(),
+        )(q, k, v, g, lse, delta)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
 def _mha_bwd(q, k, v, sri, out, lse, g, causal, scale, block_q):
     bh, seq, d = q.shape
+    if sri is None and seq > 4096 and seq % 512 == 0:
+        # the full-sequence kernels hold [block, S] f32 score tiles — at
+        # S=8192 that exceeds the 16 MiB VMEM scoped limit (measured on
+        # v5e); the chunked variant's footprint is S-independent
+        return _mha_bwd_chunked(q, k, v, out, lse, g, causal, scale)
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     lse = lse.reshape(bh, seq, 1)
     delta = delta.reshape(bh, seq, 1)
