@@ -151,3 +151,4 @@ def batch(reader, batch_size, drop_last=False):
             yield buf
 
     return batched
+from . import hub  # noqa: E402,F401

@@ -214,3 +214,24 @@ def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
     return n_nan, n_inf
 
 from . import debugging  # noqa: E402,F401
+
+
+def is_bfloat16_supported(device=None):
+    """Reference: amp/__init__.py — bf16 is the TPU-native half type."""
+    import jax
+
+    try:
+        return jax.devices()[0].platform in ("tpu", "cpu")
+    except Exception:
+        return True
+
+
+def is_float16_supported(device=None):
+    """fp16 works through XLA on TPU but bf16 is preferred (no loss scaling
+    needed); reported per actual backend capability."""
+    import jax
+
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False

@@ -28,3 +28,95 @@ __all__ = [
     "PyLayer",
     "PyLayerContext",
 ]
+
+
+def jacobian(ys, xs, batch_axis=None):
+    """Reference: python/paddle/autograd/autograd.py jacobian — here eager
+    and materialized (TPU-native: one jax.jacobian trace-and-compile instead
+    of the reference's lazy row-by-row evaluation).
+
+    Accepts either (func, x) — the functional form — or (y, x) where y was
+    computed from x under the tape (uses the tape's vjp closure)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..tensor import Tensor
+
+    if callable(ys):
+        fn = ys
+        xs_t = xs if isinstance(xs, (list, tuple)) else (xs,)
+
+        def raw(*vals):
+            out = fn(*[Tensor(v) for v in vals])
+            return out._value if isinstance(out, Tensor) else out
+
+        jac = jax.jacobian(raw, argnums=tuple(range(len(xs_t))))(
+            *[t._value for t in xs_t])
+        if not isinstance(xs, (list, tuple)):
+            return Tensor(jnp.asarray(jac[0]))
+        return [Tensor(jnp.asarray(j)) for j in jac]
+    # tensor form: the FULL Jacobian [ys.size, xs.size-shaped] via one VJP per
+    # output element through the recorded tape (retain_graph across rows)
+    import jax.numpy as _jnp
+
+    from . import tape as _tape
+    from ..tensor import Tensor as _T
+
+    xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+    y_flat = ys.reshape([-1])
+    m = y_flat.shape[0]
+    rows_per_x = [[] for _ in xs_list]
+    for i in range(m):
+        cot = _jnp.zeros((m,), y_flat._value.dtype).at[i].set(1.0)
+        gs = _tape.grad([y_flat], xs_list, grad_outputs=[_T(cot)],
+                        retain_graph=True, allow_unused=True)
+        for j, (slot, g) in enumerate(zip(rows_per_x, gs)):
+            slot.append(_jnp.zeros(xs_list[j]._value.shape)
+                        if g is None else g._value)
+    outs = [
+        _T(_jnp.stack([r.reshape(-1) for r in rows]).reshape(
+            tuple(ys.shape) + tuple(x.shape)))
+        for rows, x in zip(rows_per_x, xs_list)
+    ]
+    return outs if isinstance(xs, (list, tuple)) else outs[0]
+
+
+def hessian(func, xs, batch_axis=None):
+    """Reference: autograd.py hessian (functional form)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..tensor import Tensor
+
+    xs_t = xs if isinstance(xs, (list, tuple)) else (xs,)
+
+    def raw(*vals):
+        out = func(*[Tensor(v) for v in vals])
+        return (out._value if isinstance(out, Tensor) else out).sum()
+
+    h = jax.hessian(raw, argnums=tuple(range(len(xs_t))))(
+        *[t._value for t in xs_t])
+    if not isinstance(xs, (list, tuple)):
+        return Tensor(jnp.asarray(h[0][0]))
+    return [[Tensor(jnp.asarray(c)) for c in row] for row in h]
+
+
+class saved_tensors_hooks:
+    """Reference: autograd/saved_tensors_hooks.py — pack/unpack hooks for
+    tensors saved by PyLayer.save_for_backward. Residuals captured inside
+    compiled vjp closures are jax-internal and not interceptable; the hook
+    surface covers the PyLayer path (the reference's documented use case)."""
+
+    _active = None
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        saved_tensors_hooks._active = (self.pack_hook, self.unpack_hook)
+        return self
+
+    def __exit__(self, *exc):
+        saved_tensors_hooks._active = None
+        return False

@@ -15,16 +15,27 @@ from . import tape
 class PyLayerContext:
     def __init__(self):
         self._saved = []
+        self._unpack = None
         self.not_inplace_tensors = ()
 
     def save_for_backward(self, *tensors):
-        self._saved = list(tensors)
+        from . import saved_tensors_hooks as _sth
+
+        hooks = _sth._active
+        if hooks is not None:
+            self._saved = [hooks[0](t) for t in tensors]
+            self._unpack = hooks[1]
+        else:
+            self._saved = list(tensors)
+            self._unpack = None
 
     def saved_tensor(self):
+        if self._unpack is not None:
+            return [self._unpack(t) for t in self._saved]
         return list(self._saved)
 
     # paddle alias
-    saved_tensors = property(lambda self: list(self._saved))
+    saved_tensors = property(lambda self: self.saved_tensor())
 
 
 class PyLayerMeta(type):

@@ -230,3 +230,52 @@ class cuda:
     @staticmethod
     def empty_cache():
         pass
+
+
+class IPUPlace:
+    """Reference: paddle.device.IPUPlace — accepted for script parity; no IPU
+    backend exists here (the PJRT plugin ABI is the extension point)."""
+
+    def __repr__(self):
+        return "Place(ipu)"
+
+
+def get_cudnn_version():
+    """Reference: device/__init__.py — no CUDA stack on TPU builds."""
+    return None
+
+
+def is_compiled_with_cinn():
+    """The Pallas kernel layer plays CINN's role (SURVEY §2 row 11); the CINN
+    compiler itself is not part of this build."""
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_custom_device(device_name=None):
+    """PJRT plugins are the custom-device mechanism: true iff a non-builtin
+    platform is registered (e.g. the out-of-tree TPU tunnel plugin)."""
+    import jax
+
+    try:
+        return jax.devices()[0].platform not in ("cpu", "gpu", "cuda")
+    except Exception:
+        return False
+
+
+def is_compiled_with_distribute():
+    return True  # jax.distributed + the store control plane always ship
+
+
+def set_stream(stream=None):
+    """Reference: device/__init__.py set_stream — XLA owns stream assignment;
+    accepted and ignored (documented no-op, same as the Config stream knobs
+    in inference/__init__.py)."""
+    return stream

@@ -60,3 +60,66 @@ def fftshift(x, axes=None):
 
 def ifftshift(x, axes=None):
     return apply_op(lambda v: jnp.fft.ifftshift(v, axes=axes), "ifftshift", x)
+
+
+def _hermitian_nd(fn, name, n_axes):
+    """Reference: python/paddle/fft.py hfft2/hfftn/ihfft2/ihfftn."""
+
+    def wrapped(x, s=None, axes=None, norm="backward", name_arg=None):
+        def f(v):
+            ax = tuple(axes) if axes is not None else tuple(
+                range(-(n_axes or v.ndim), 0))
+            return fn(v, s=s, axes=ax, norm=norm)
+
+        return apply_op(f, name, x)
+
+    return wrapped
+
+
+def _hfftn_impl(v, s=None, axes=None, norm="backward"):
+    # scipy identity: hfftn(x, s) == irfftn(conj(x), s) * prod(s) under the
+    # backward norm (hfft(a, n) == irfft(conj(a), n) * n generalized per axis)
+    axes = tuple(axes)
+    if s is None:
+        shape = [2 * (v.shape[a] - 1) if a == axes[-1] or a == v.ndim + axes[-1]
+                 else v.shape[a] for a in axes]
+    else:
+        shape = list(s)
+    out = jnp.fft.irfftn(jnp.conj(v), s=shape, axes=axes, norm=norm)
+    if norm in (None, "backward"):
+        n = 1
+        for d in shape:
+            n *= d
+        out = out * n
+    elif norm == "ortho":
+        n = 1
+        for d in shape:
+            n *= d
+        out = out * jnp.sqrt(n)
+    return out
+
+
+def _ihfftn_impl(v, s=None, axes=None, norm="backward"):
+    # scipy identity: ihfftn(x, s) == conj(rfftn(x, s)) / prod(s) (backward)
+    axes = tuple(axes)
+    shape = list(s) if s is not None else [v.shape[a] for a in axes]
+    out = jnp.conj(jnp.fft.rfftn(v.astype(jnp.float64)
+                                 if v.dtype.kind != "c" else v,
+                                 s=shape, axes=axes, norm=norm))
+    if norm in (None, "backward"):
+        n = 1
+        for d in shape:
+            n *= d
+        out = out / n
+    elif norm == "ortho":
+        n = 1
+        for d in shape:
+            n *= d
+        out = out / jnp.sqrt(n)
+    return out
+
+
+hfft2 = _hermitian_nd(_hfftn_impl, "hfft2", 2)
+hfftn = _hermitian_nd(_hfftn_impl, "hfftn", None)
+ihfft2 = _hermitian_nd(_ihfftn_impl, "ihfft2", 2)
+ihfftn = _hermitian_nd(_ihfftn_impl, "ihfftn", None)

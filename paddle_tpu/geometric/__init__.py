@@ -124,3 +124,131 @@ segment_sum = _segment("segment_sum", "sum")
 segment_mean = _segment("segment_mean", "mean")
 segment_max = _segment("segment_max", "max")
 segment_min = _segment("segment_min", "min")
+
+
+def _sample_neighbors_impl(row, colptr, input_nodes, sample_size, eids,
+                           return_eids, edge_weight=None):
+    """Shared body for (weighted_)sample_neighbors: CSC neighbor sampling on
+    the host (input-pipeline work, like the reference's CPU kernel), uniform
+    when edge_weight is None, weight-proportional otherwise."""
+    import numpy as np
+
+    from ..tensor import Tensor
+
+    rowv = np.asarray(row._value if isinstance(row, Tensor) else row)
+    cpv = np.asarray(colptr._value if isinstance(colptr, Tensor) else colptr)
+    nodes = np.asarray(input_nodes._value if isinstance(input_nodes, Tensor)
+                       else input_nodes)
+    wv = (np.asarray(edge_weight._value if isinstance(edge_weight, Tensor)
+                     else edge_weight).astype(np.float64)
+          if edge_weight is not None else None)
+    ev = (np.asarray(eids._value if isinstance(eids, Tensor) else eids)
+          if eids is not None else None)
+    out_n, out_e, counts = [], [], []
+    rs = np.random.RandomState()
+    for n in nodes.tolist():
+        lo, hi = int(cpv[n]), int(cpv[n + 1])
+        neigh = rowv[lo:hi]
+        idx = np.arange(lo, hi)
+        if 0 <= sample_size < len(neigh):
+            if wv is not None:
+                p = wv[lo:hi]
+                p = p / p.sum()
+                pick = rs.choice(len(neigh), size=sample_size, replace=False,
+                                 p=p)
+            else:
+                pick = rs.choice(len(neigh), size=sample_size, replace=False)
+            neigh, idx = neigh[pick], idx[pick]
+        out_n.append(neigh)
+        counts.append(len(neigh))
+        if ev is not None:
+            out_e.append(ev[idx])
+    import jax.numpy as jnp
+
+    on = Tensor(jnp.asarray(np.concatenate(out_n) if out_n else
+                            np.zeros((0,), rowv.dtype)))
+    oc = Tensor(jnp.asarray(np.asarray(counts, np.int64)))
+    if return_eids:
+        if ev is None:
+            raise ValueError("return_eids=True requires eids")
+        return on, oc, Tensor(jnp.asarray(np.concatenate(out_e)))
+    return on, oc
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                     eids=None, return_eids=False, perm_buffer=None, name=None):
+    """Reference: geometric/sampling/neighbors.py — uniform neighbor sampling
+    from a CSC graph (row=concatenated neighbor lists, colptr=offsets)."""
+    return _sample_neighbors_impl(row, colptr, input_nodes, sample_size, eids,
+                                  return_eids)
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Reference: geometric/sampling/neighbors.py weighted variant — sampling
+    probability proportional to edge weight."""
+    return _sample_neighbors_impl(row, colptr, input_nodes, sample_size, eids,
+                                  return_eids, edge_weight=edge_weight)
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Reference: geometric/reindex.py — renumber (x ∪ neighbors) into a
+    contiguous id space; returns (reindexed_src, reindexed_dst, out_nodes)."""
+    import numpy as np
+
+    from ..tensor import Tensor
+
+    xv = np.asarray(x._value if isinstance(x, Tensor) else x)
+    nv = np.asarray(neighbors._value if isinstance(neighbors, Tensor)
+                    else neighbors)
+    cv = np.asarray(count._value if isinstance(count, Tensor) else count)
+    mapping = {}
+    out_nodes = []
+    for n in xv.tolist():
+        if n not in mapping:
+            mapping[n] = len(mapping)
+            out_nodes.append(n)
+    for n in nv.tolist():
+        if n not in mapping:
+            mapping[n] = len(mapping)
+            out_nodes.append(n)
+    reindex_src = np.asarray([mapping[n] for n in nv.tolist()], np.int64)
+    reindex_dst = np.repeat(np.arange(len(xv), dtype=np.int64), cv)
+    import jax.numpy as jnp
+
+    return (Tensor(jnp.asarray(reindex_src)), Tensor(jnp.asarray(reindex_dst)),
+            Tensor(jnp.asarray(np.asarray(out_nodes, xv.dtype))))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Reference: geometric/reindex.py heterogeneous variant: per-edge-type
+    neighbor/count lists sharing ONE node id space."""
+    import numpy as np
+
+    from ..tensor import Tensor
+
+    xv = np.asarray(x._value if isinstance(x, Tensor) else x)
+    mapping = {}
+    out_nodes = []
+    for n in xv.tolist():
+        if n not in mapping:
+            mapping[n] = len(mapping)
+            out_nodes.append(n)
+    srcs, dsts = [], []
+    for nb, ct in zip(neighbors, count):
+        nv = np.asarray(nb._value if isinstance(nb, Tensor) else nb)
+        cv = np.asarray(ct._value if isinstance(ct, Tensor) else ct)
+        for n in nv.tolist():
+            if n not in mapping:
+                mapping[n] = len(mapping)
+                out_nodes.append(n)
+        srcs.append(np.asarray([mapping[n] for n in nv.tolist()], np.int64))
+        dsts.append(np.repeat(np.arange(len(xv), dtype=np.int64), cv))
+    import jax.numpy as jnp
+
+    return (Tensor(jnp.asarray(np.concatenate(srcs))),
+            Tensor(jnp.asarray(np.concatenate(dsts))),
+            Tensor(jnp.asarray(np.asarray(out_nodes, xv.dtype))))

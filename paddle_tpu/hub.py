@@ -1,0 +1,57 @@
+"""paddle.hub — load models/entrypoints from a local repo directory.
+
+Reference: python/paddle/hub.py (list/help/load over a hubconf.py). Zero
+network egress: only source='local' works; github/gitee sources raise with
+the documented pointer (same policy as vision/audio datasets)."""
+from __future__ import annotations
+
+import importlib.util
+import os
+
+__all__ = ["list", "help", "load", "load_state_dict_from_url"]
+
+_builtin_list = list
+
+
+def _hubconf(repo_dir):
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no hubconf.py under {repo_dir}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _require_local(source):
+    if source != "local":
+        raise RuntimeError(
+            "no network egress: only source='local' is supported — clone the "
+            "repo yourself and pass its path")
+
+
+def list(repo_dir, source="local", force_reload=False):
+    _require_local(source)
+    mod = _hubconf(repo_dir)
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):
+    _require_local(source)
+    return getattr(_hubconf(repo_dir), model).__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    _require_local(source)
+    return getattr(_hubconf(repo_dir), model)(**kwargs)
+
+
+def load_state_dict_from_url(url, model_dir=None, check_hash=False,
+                             file_name=None, map_location=None):
+    """Reference: hub.load_state_dict_from_url. No network egress: raises
+    with the local-path recipe (download the file yourself, then
+    paddle.load it)."""
+    raise RuntimeError(
+        "no network egress: download the checkpoint out-of-band and load it "
+        "with paddle.load(path) + layer.set_state_dict")

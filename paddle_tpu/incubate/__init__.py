@@ -3,3 +3,13 @@ from . import distributed  # noqa: F401
 from . import nn  # noqa: F401
 
 from . import asp  # noqa: E402,F401
+from ._tail import (  # noqa: E402,F401
+    LookAhead, ModelAverage, graph_khop_sampler, graph_reindex,
+    graph_sample_neighbors, graph_send_recv, identity_loss, segment_max,
+    segment_mean, segment_min, segment_sum, softmax_mask_fuse,
+    softmax_mask_fuse_upper_triangle,
+)
+# reference __all__ lists `inference` (incubate/inference decorator module);
+# the deployable-inference surface here is paddle.inference — alias the
+# namespace so incubate.inference resolves
+from .. import inference  # noqa: E402,F401

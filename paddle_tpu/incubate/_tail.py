@@ -1,0 +1,165 @@
+"""paddle.incubate top-level API tail. Reference: python/paddle/incubate/
+__init__.py __all__ — graph ops (thin aliases over paddle.geometric, the
+reference keeps both spellings), fused softmax-mask ops, identity_loss, and
+the LookAhead / ModelAverage optimizer wrappers."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..geometric import (
+    reindex_graph as graph_reindex,
+    sample_neighbors as graph_sample_neighbors,
+    segment_max,
+    segment_mean,
+    segment_min,
+    segment_sum,
+    send_u_recv,
+)
+from ..ops import apply_op
+from ..optimizer import Optimizer
+from ..tensor import Tensor
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum", out_size=None,
+                    name=None):
+    """Reference: incubate/operators/graph_send_recv.py — the pre-geometric
+    spelling of send_u_recv."""
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Reference: incubate/operators/graph_khop_sampler.py — multi-hop
+    neighbor sampling: chain sample_neighbors per hop, then reindex."""
+    from ..geometric import reindex_graph, sample_neighbors
+
+    cur = input_nodes
+    all_neighbors, all_counts = [], []
+    for size in sample_sizes:
+        neigh, cnt = sample_neighbors(row, colptr, cur, sample_size=size)
+        all_neighbors.append(neigh)
+        all_counts.append(cnt)
+        cur = neigh
+    import numpy as np
+
+    neighbors = Tensor(jnp.asarray(np.concatenate(
+        [np.asarray(n._value) for n in all_neighbors])))
+    counts = Tensor(jnp.asarray(np.concatenate(
+        [np.asarray(c._value) for c in all_counts])))
+    # single flat reindex over the union (dst built per-hop by the caller in
+    # the reference; the sampled edge list is what训练 consumes)
+    src, dst, nodes = reindex_graph(input_nodes, neighbors, counts)
+    if return_eids:
+        raise NotImplementedError("sorted_eids return is not supported")
+    return neighbors, counts, nodes, src
+
+
+def identity_loss(x, reduction="none"):
+    """Reference: incubate/operators/identity_loss.py — marks x as a loss for
+    the IPU scheduler; numerically reduce-or-passthrough."""
+    red = {0: "sum", 1: "mean", 2: "none"}.get(reduction, reduction)
+
+    def f(v):
+        if red == "sum":
+            return jnp.sum(v)
+        if red == "mean":
+            return jnp.mean(v)
+        return v
+
+    return apply_op(f, "identity_loss", x)
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """Reference: incubate/operators/softmax_mask_fuse.py — softmax(x + mask)
+    in one pass (XLA fuses; the CUDA kernel's raison d'etre)."""
+    return apply_op(
+        lambda v, m: jax.nn.softmax((v + m).astype(jnp.float32), axis=-1)
+        .astype(v.dtype), "softmax_mask_fuse", x, mask)
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """Reference: softmax_mask_fuse_upper_triangle — causal-masked softmax
+    without materializing the mask input."""
+
+    def f(v):
+        s = v.shape[-1]
+        rows = jnp.arange(v.shape[-2])[:, None]
+        cols = jnp.arange(s)[None, :]
+        allowed = cols <= rows
+        vv = jnp.where(allowed, v.astype(jnp.float32), jnp.float32(-1e9))
+        return jax.nn.softmax(vv, axis=-1).astype(v.dtype)
+
+    return apply_op(f, "softmax_mask_fuse_upper_triangle", x)
+
+
+class LookAhead(Optimizer):
+    """Reference: incubate/optimizer/lookahead.py — wraps an inner optimizer;
+    every k steps the slow weights pull the fast weights back by alpha."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._slow = {}
+        self._lk_step = 0
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["inner_optimizer"], name)
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._lk_step += 1
+        if self._lk_step % self.k:
+            return
+        for _, p in self.inner_optimizer._parameters_list():
+            slow = self._slow.get(id(p))
+            if slow is None:
+                slow = self._slow[id(p)] = p._value
+                continue
+            slow = slow + self.alpha * (p._value - slow)
+            self._slow[id(p)] = slow
+            p._value = slow
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def minimize(self, loss):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+class ModelAverage(Optimizer):
+    """Reference: incubate/optimizer/modelaverage.py — maintains the running
+    average of parameters; apply()/restore() swap it in and out for eval."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000, name=None):
+        super().__init__(0.0, parameters, None, None, name)
+        self._sum = {}
+        self._count = 0
+        self._backup = None
+
+    def step(self):
+        self._count += 1
+        for _, p in self._parameters_list():
+            acc = self._sum.get(id(p))
+            self._sum[id(p)] = p._value if acc is None else acc + p._value
+
+    def apply(self, executor=None, need_restore=True):
+        self._backup = {}
+        for _, p in self._parameters_list():
+            if id(p) in self._sum and self._count:
+                self._backup[id(p)] = p._value
+                p._value = (self._sum[id(p)] / self._count).astype(
+                    p._value.dtype)
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for _, p in self._parameters_list():
+            if id(p) in self._backup:
+                p._value = self._backup[id(p)]
+        self._backup = None

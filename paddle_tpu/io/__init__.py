@@ -161,6 +161,22 @@ class RandomSampler(Sampler):
         return self.num_samples
 
 
+class SubsetRandomSampler(Sampler):
+    """Reference: io/sampler.py SubsetRandomSampler — random permutation of a
+    fixed index subset."""
+
+    def __init__(self, indices, generator=None):
+        super().__init__(list(indices))
+        self.indices = list(indices)
+
+    def __iter__(self):
+        return iter(self.indices[i]
+                    for i in np.random.permutation(len(self.indices)))
+
+    def __len__(self):
+        return len(self.indices)
+
+
 class WeightedRandomSampler(Sampler):
     def __init__(self, weights, num_samples, replacement=True):
         self.weights = np.asarray([float(w) for w in weights])

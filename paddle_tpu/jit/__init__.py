@@ -286,3 +286,23 @@ def jax_asarray(a):
     import jax.numpy as jnp
 
     return jnp.asarray(a)
+
+
+_verbosity = 0
+_code_level = 0
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """Reference: jit/sot/... set_verbosity — tracing-log verbosity. The
+    trace-and-compile path has no bytecode translator; the knob gates the
+    jit-layer debug logging."""
+    global _verbosity
+    _verbosity = int(level)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Reference: api.py set_code_level — print transformed code. There is no
+    source transform here (tracing replaces dy2static); levels kept for
+    script parity."""
+    global _code_level
+    _code_level = int(level)

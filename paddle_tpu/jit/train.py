@@ -368,11 +368,14 @@ class TrainStep:
         # materialize zero-init accumulators on first call so the traced shapes exist
         if not acc:
             names = getattr(inner_opt, "_acc_names", ())
+            acc_init = getattr(inner_opt, "_acc_init",
+                               lambda name, v: jnp.zeros_like(v))
             for acc_name in names:
                 if acc_name == "moment2_max" and not getattr(inner_opt, "_amsgrad", False):
                     continue
                 acc[acc_name] = {
-                    k: jnp.zeros_like(t._value) for k, t in self._trainable.items()
+                    k: acc_init(acc_name, t._value)
+                    for k, t in self._trainable.items()
                 }
             if self._stage is not None:
                 for acc_name, per in acc.items():

@@ -196,3 +196,38 @@ def calculate_gain(nonlinearity, param=None):
     if nonlinearity not in recommended:
         raise ValueError(f"unsupported nonlinearity {nonlinearity}")
     return recommended[nonlinearity]
+
+
+class Bilinear(Initializer):
+    """Reference: nn/initializer/Bilinear — bilinear-upsample kernel init for
+    transposed convs (weight shape [C_out, C_in, K, K])."""
+
+    def __call__(self, shape, dtype=jnp.float32, key=None):
+        shape = tuple(int(s) for s in shape)
+        if len(shape) != 4:
+            raise ValueError(f"Bilinear expects a 4-D conv weight, got {shape}")
+        k = shape[-1]
+        factor = (k + 1) // 2
+        center = factor - 1.0 if k % 2 == 1 else factor - 0.5
+        og = np.ogrid[:k, :k]
+        filt = ((1 - np.abs(og[0] - center) / factor)
+                * (1 - np.abs(og[1] - center) / factor))
+        w = np.zeros(shape, np.float32)
+        for i in range(min(shape[0], shape[1])):
+            w[i, i] = filt
+        return jnp.asarray(w, dtype)
+
+
+_global_initializer = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Reference: nn/initializer/set_global_initializer — default initializer
+    for parameters created WITHOUT an explicit one after this call. Pass
+    None to reset."""
+    global _global_initializer
+    _global_initializer = (weight_init, bias_init)
+
+
+def get_global_initializer():
+    return _global_initializer

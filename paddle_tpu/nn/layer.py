@@ -138,6 +138,10 @@ class Layer:
         dtype = _dt.convert_dtype(dtype) or self._dtype or _dt.get_default_dtype()
         init = attr.initializer or default_initializer
         if init is None:
+            glob = I.get_global_initializer()
+            if glob is not None:
+                init = glob[1] if (is_bias and glob[1] is not None) else glob[0]
+        if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierUniform()
         value = init(shape, dtype)
         p = Parameter(value, trainable=attr.trainable, name=attr.name)

@@ -8,7 +8,7 @@ import numpy as np
 from ...tensor import Tensor
 
 __all__ = ["weight_norm", "remove_weight_norm", "spectral_norm", "parameters_to_vector",
-           "vector_to_parameters"]
+           "vector_to_parameters", "clip_grad_norm_", "clip_grad_value_"]
 
 
 def weight_norm(layer, name="weight", dim=0):
@@ -112,3 +112,34 @@ def vector_to_parameters(vec, parameters, name=None):
         n = p.size
         p._value = v[offset:offset + n].reshape(p._value.shape)
         offset += n
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """Reference: nn/utils/clip_grad_norm_.py — in-place global-norm clip of
+    .grad; returns the pre-clip total norm."""
+    params = [p for p in (parameters if isinstance(parameters, (list, tuple))
+                          else [parameters]) if p._grad is not None]
+    if not params:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(p._grad)) for p in params]))
+    else:
+        total = jnp.power(
+            sum(jnp.sum(jnp.power(jnp.abs(p._grad.astype(jnp.float32)),
+                                  norm_type)) for p in params),
+            1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError("non-finite total norm in clip_grad_norm_")
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in params:
+        p._grad = (p._grad.astype(jnp.float32) * scale).astype(p._grad.dtype)
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    """Reference: nn/utils/clip_grad_value_.py — element clamp of .grad."""
+    params = parameters if isinstance(parameters, (list, tuple)) else [parameters]
+    for p in params:
+        if p._grad is not None:
+            p._grad = jnp.clip(p._grad, -clip_value, clip_value)

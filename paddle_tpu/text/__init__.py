@@ -1,4 +1,6 @@
 """paddle.text surface. Reference: python/paddle/text/__init__.py."""
 from . import datasets  # noqa: F401
-from .datasets import Imdb, Imikolov, UCIHousing  # noqa: F401
+from .datasets import (  # noqa: F401
+    Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16,
+)
 from .viterbi_decode import ViterbiDecoder, viterbi_decode  # noqa: F401

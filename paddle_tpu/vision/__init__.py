@@ -3,3 +3,48 @@ from . import datasets  # noqa: F401
 from . import models  # noqa: F401
 from . import ops  # noqa: F401
 from . import transforms  # noqa: F401
+
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend):
+    """Reference: vision/image.py — 'pil' | 'cv2' | 'tensor' dataset decode
+    backend. PIL ships in this build; cv2 accepted if importable."""
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(
+            f"backend must be 'pil'/'cv2'/'tensor', got {backend!r}")
+    if backend == "cv2":
+        try:
+            import cv2  # noqa: F401
+        except ImportError as e:
+            raise ValueError("cv2 backend requested but not installed") from e
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Reference: vision/image.py image_load."""
+    b = backend or _image_backend
+    if b == "pil":
+        from PIL import Image
+
+        return Image.open(path)
+    if b == "cv2":
+        import cv2
+
+        return cv2.imread(path)
+    # tensor backend: decoded chw uint8 tensor
+    import numpy as np
+
+    from PIL import Image
+
+    from ..tensor import Tensor
+    import jax.numpy as jnp
+
+    arr = np.asarray(Image.open(path).convert("RGB"))
+    return Tensor(jnp.asarray(arr.transpose(2, 0, 1)))

@@ -213,3 +213,218 @@ class ImageFolder(Dataset):
 
     def __len__(self):
         return len(self.samples)
+
+
+class _TarIndex:
+    """Per-process cached tarfile handle: the member index is built once at
+    first use in each process (fork-safe for DataLoader workers — handles are
+    not shared across pids), so __getitem__ is an O(1) seek, not a fresh
+    archive scan (review finding: reopening per sample is quadratic I/O)."""
+
+    def __init__(self, path):
+        self.path = path
+        self._handles = {}
+
+    def extract(self, name):
+        import os as _os
+
+        pid = _os.getpid()
+        tf = self._handles.get(pid)
+        if tf is None:
+            tf = self._handles[pid] = tarfile.open(self.path, "r:*")
+        return tf.extractfile(name).read()
+
+    def __getstate__(self):
+        return {"path": self.path}
+
+    def __setstate__(self, state):
+        self.path = state["path"]
+        self._handles = {}
+
+
+class Flowers(Dataset):
+    """Oxford 102 Flowers (reference flowers.py). Local files only:
+    ``data_file`` = 102flowers.tgz (jpg/image_XXXXX.jpg members),
+    ``label_file`` = imagelabels.mat, ``setid_file`` = setid.mat.
+    scipy-free .mat reading via a tiny MAT5 parser for the two 1-D arrays."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False, backend=None):
+        if data_file is None or label_file is None or setid_file is None:
+            if download:
+                raise RuntimeError(_NO_EGRESS)
+            raise ValueError(
+                f"Flowers needs data_file, label_file and setid_file "
+                f"({_NO_EGRESS})")
+        self.transform = transform
+        labels = self._mat_int_array(label_file)
+        ids = self._mat_split_ids(setid_file, mode)
+        self._names = {}
+        with tarfile.open(data_file, "r:*") as tf:
+            for m in tf.getmembers():
+                if m.name.endswith(".jpg"):
+                    # image_00001.jpg -> 1
+                    num = int(m.name.split("_")[-1].split(".")[0])
+                    self._names[num] = m.name
+        self._tar = _TarIndex(data_file)
+        self.indexes = [i for i in ids if i in self._names]
+        self.labels = {i: int(labels[i - 1]) - 1 for i in self.indexes}
+
+    @staticmethod
+    def _mat_int_array(path):
+        """Read the single numeric matrix out of a MAT5 file (imagelabels.mat
+        holds one 1xN uint8/uint16/double array)."""
+        import io as _io
+
+        with open(path, "rb") as f:
+            f.seek(128)  # header
+            data = f.read()
+        arrs = Flowers._parse_mat_elements(data)
+        if not arrs:
+            raise ValueError(f"no numeric array found in {path}")
+        return arrs[0].ravel()
+
+    @staticmethod
+    def _mat_split_ids(path, mode):
+        with open(path, "rb") as f:
+            f.seek(128)
+            data = f.read()
+        arrs = Flowers._parse_mat_elements(data)
+        # setid.mat: trnid, valid, tstid (reference: train=trnid, valid=valid,
+        # test=tstid) in file order
+        key = {"train": 0, "valid": 1, "test": 2}[mode]
+        if len(arrs) <= key:
+            raise ValueError(f"setid.mat lacks split {mode}")
+        return [int(v) for v in arrs[key].ravel()]
+
+    @staticmethod
+    def _parse_mat_elements(data):
+        """Minimal MAT5 reader: walks top-level miMATRIX elements, returns
+        their numeric payloads (handles miUINT8/16/32, miINT variants,
+        miDOUBLE; zlib-compressed elements supported)."""
+        import struct as _st
+        import zlib
+
+        type_fmt = {1: ("b", 1), 2: ("B", 1), 3: ("h", 2), 4: ("H", 2),
+                    5: ("i", 4), 6: ("I", 4), 9: ("d", 8), 7: ("f", 4)}
+        out = []
+
+        def walk(buf):
+            off = 0
+            while off + 8 <= len(buf):
+                dtype, nbytes = _st.unpack_from("<II", buf, off)
+                small = dtype >> 16
+                if small:  # small data element
+                    payload = buf[off + 4:off + 8]
+                    dtype &= 0xFFFF
+                    nbytes = small
+                    step = 8
+                else:
+                    payload = buf[off + 8:off + 8 + nbytes]
+                    step = 8 + ((nbytes + 7) // 8) * 8
+                if dtype == 15:  # miCOMPRESSED
+                    walk(zlib.decompress(payload))
+                elif dtype == 14:  # miMATRIX: flags(16) dims name data
+                    walk_matrix(payload)
+                elif dtype in type_fmt:
+                    fmt, size = type_fmt[dtype]
+                    n = nbytes // size
+                    out.append(np.asarray(
+                        _st.unpack_from(f"<{n}{fmt}", payload, 0)))
+                off += step
+            return out
+
+        def walk_matrix(buf):
+            off = 0
+            seen_numeric = []
+            while off + 8 <= len(buf):
+                dtype, nbytes = _st.unpack_from("<II", buf, off)
+                small = dtype >> 16
+                if small:
+                    payload = buf[off + 4:off + 8]
+                    dtype &= 0xFFFF
+                    nbytes = small
+                    step = 8
+                else:
+                    payload = buf[off + 8:off + 8 + nbytes]
+                    step = 8 + ((nbytes + 7) // 8) * 8
+                if dtype in type_fmt and nbytes:
+                    fmt, size = type_fmt[dtype]
+                    n = nbytes // size
+                    seen_numeric.append(np.asarray(
+                        _st.unpack_from(f"<{n}{fmt}", payload, 0)))
+                off += step
+            # miMATRIX payload order: flags, dims, name, real data — the
+            # LAST numeric block is the data
+            if len(seen_numeric) >= 4:
+                out.append(seen_numeric[-1])
+            elif seen_numeric:
+                out.append(seen_numeric[-1])
+
+        walk(data)
+        return out
+
+    def __getitem__(self, idx):
+        import io as _io
+
+        from PIL import Image
+
+        num = self.indexes[idx]
+        img = Image.open(_io.BytesIO(
+            self._tar.extract(self._names[num]))).convert("RGB")
+        arr = np.asarray(img)
+        if self.transform is not None:
+            arr = self.transform(arr)
+        return arr, np.int64(self.labels[num])
+
+    def __len__(self):
+        return len(self.indexes)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation (reference voc2012.py): the VOCtrainval
+    tar with JPEGImages/, SegmentationClass/ and ImageSets/Segmentation/
+    {train,val,trainval}.txt. Yields (image, label_mask) numpy pairs."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if data_file is None:
+            if download:
+                raise RuntimeError(_NO_EGRESS)
+            raise ValueError(f"VOC2012 needs data_file ({_NO_EGRESS})")
+        self.transform = transform
+        self._tar = _TarIndex(data_file)
+        mode = "train" if mode == "train" else ("val" if mode in ("val", "valid", "test") else mode)
+        with tarfile.open(data_file, "r:*") as tf:
+            names = tf.getnames()
+            split = [n for n in names
+                     if n.endswith(f"ImageSets/Segmentation/{mode}.txt")]
+            if not split:
+                raise ValueError(f"archive lacks the {mode} split list")
+            ids = tf.extractfile(split[0]).read().decode().split()
+            self._jpg = {}
+            self._png = {}
+            for n in names:
+                base = os.path.basename(n)
+                if n.endswith(".jpg") and "JPEGImages" in n:
+                    self._jpg[base[:-4]] = n
+                elif n.endswith(".png") and "SegmentationClass" in n:
+                    self._png[base[:-4]] = n
+        self.ids = [i for i in ids if i in self._jpg and i in self._png]
+
+    def __getitem__(self, idx):
+        import io as _io
+
+        from PIL import Image
+
+        key = self.ids[idx]
+        img = Image.open(_io.BytesIO(
+            self._tar.extract(self._jpg[key]))).convert("RGB")
+        lab = Image.open(_io.BytesIO(self._tar.extract(self._png[key])))
+        arr, mask = np.asarray(img), np.asarray(lab)
+        if self.transform is not None:
+            arr = self.transform(arr)
+        return arr, mask
+
+    def __len__(self):
+        return len(self.ids)

@@ -13,7 +13,8 @@ from ..tensor import Tensor
 
 __all__ = ["nms", "roi_align", "roi_pool", "box_coder", "yolo_box", "yolo_loss",
            "deform_conv2d", "DeformConv2D", "distribute_fpn_proposals",
-           "generate_proposals", "PSRoIPool", "RoIAlign", "RoIPool"]
+           "generate_proposals", "PSRoIPool", "RoIAlign", "RoIPool",
+           "read_file", "decode_jpeg", "prior_box", "matrix_nms"]
 
 
 def _iou_matrix(boxes):
@@ -625,3 +626,171 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
     if return_rois_num:
         return rois, scores_out, nums
     return rois, scores_out
+
+
+def read_file(filename, name=None):
+    """Reference: vision/ops.py read_file — raw file bytes as a uint8 tensor."""
+    import jax.numpy as jnp
+
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), np.uint8)
+    return Tensor(jnp.asarray(data))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Reference: vision/ops.py decode_jpeg (nvjpeg kernel) — host-side PIL
+    decode (image io is input-pipeline work), returns CHW uint8."""
+    import io as _io
+
+    import jax.numpy as jnp
+    from PIL import Image
+
+    raw = bytes(np.asarray(x._value if isinstance(x, Tensor) else x,
+                           np.uint8))
+    img = Image.open(_io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode in ("rgb", "RGB"):
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """Reference: vision/ops.py prior_box — SSD prior boxes over the feature
+    map grid (host math mirrored from the CUDA kernel's enumeration order)."""
+    import jax.numpy as jnp
+
+    fh, fw = input.shape[2], input.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    boxes = []
+    for y in range(fh):
+        for x in range(fw):
+            cx = (x + offset) * step_w
+            cy = (y + offset) * step_h
+            cell = []
+            for k, ms in enumerate(min_sizes):
+                ms = float(ms)
+                if min_max_aspect_ratios_order:
+                    cell.append((cx, cy, ms, ms))
+                    if max_sizes:
+                        big = np.sqrt(ms * float(max_sizes[k]))
+                        cell.append((cx, cy, big, big))
+                    for ar in ars:
+                        if abs(ar - 1.0) < 1e-6:
+                            continue
+                        r = np.sqrt(ar)
+                        cell.append((cx, cy, ms * r, ms / r))
+                else:
+                    for ar in ars:
+                        r = np.sqrt(ar)
+                        cell.append((cx, cy, ms * r, ms / r))
+                    if max_sizes:
+                        big = np.sqrt(ms * float(max_sizes[k]))
+                        cell.append((cx, cy, big, big))
+            boxes.extend(cell)
+    b = np.asarray(boxes, np.float32)
+    out = np.stack([
+        (b[:, 0] - b[:, 2] / 2) / iw, (b[:, 1] - b[:, 3] / 2) / ih,
+        (b[:, 0] + b[:, 2] / 2) / iw, (b[:, 1] + b[:, 3] / 2) / ih,
+    ], 1).reshape(fh, fw, -1, 4)
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(var))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Reference: vision/ops.py matrix_nms (SOLOv2) — parallel soft-NMS:
+    scores decayed by max-IoU against higher-scored peers, no sequential
+    suppression loop."""
+    import jax.numpy as jnp
+
+    bv = np.asarray(bboxes._value if isinstance(bboxes, Tensor) else bboxes)
+    sv = np.asarray(scores._value if isinstance(scores, Tensor) else scores)
+    outs, indices, nums = [], [], []
+    offset = 0.0 if normalized else 1.0
+    for b in range(bv.shape[0]):
+        dets, idxs = [], []
+        for c in range(sv.shape[1]):
+            if c == background_label:
+                continue
+            s = sv[b, c]
+            keep = np.where(s > score_threshold)[0]
+            if keep.size == 0:
+                continue
+            order = keep[np.argsort(-s[keep])][:nms_top_k]
+            boxes = bv[b, order]
+            ss = s[order]
+            x1, y1, x2, y2 = boxes.T
+            area = (x2 - x1 + offset) * (y2 - y1 + offset)
+            n = len(order)
+            xx1 = np.maximum(x1[:, None], x1[None, :])
+            yy1 = np.maximum(y1[:, None], y1[None, :])
+            xx2 = np.minimum(x2[:, None], x2[None, :])
+            yy2 = np.minimum(y2[:, None], y2[None, :])
+            inter = (np.clip(xx2 - xx1 + offset, 0, None)
+                     * np.clip(yy2 - yy1 + offset, 0, None))
+            iou = inter / (area[:, None] + area[None, :] - inter)
+            # iou[j, i] for j < i = overlap of det i with the better det j
+            iou = np.triu(iou, 1)
+            # compensate_j = worst overlap det j itself suffered from ITS
+            # betters (column max); decay_i = min over j<i of
+            # f(iou_ji)/f(compensate_j)  (SOLOv2 matrix NMS)
+            comp = iou.max(axis=0)
+            if use_gaussian:
+                decay_mat = np.exp(-(iou ** 2 - comp[:, None] ** 2)
+                                   / gaussian_sigma)
+            else:
+                decay_mat = (1 - iou) / np.clip(1 - comp[:, None], 1e-6, None)
+            # only j < i entries participate; pad the rest with +inf so the
+            # column min ignores them (det 0 keeps decay 1.0)
+            decay_mat = np.where(np.triu(np.ones((n, n), bool), 1), decay_mat,
+                                 np.inf)
+            decay = np.minimum(decay_mat.min(axis=0), 1.0)
+            new_s = ss * decay
+            for i in range(n):
+                if new_s[i] > post_threshold:
+                    dets.append([c, new_s[i], *boxes[i]])
+                    idxs.append(order[i])
+        if dets:
+            dets = np.asarray(dets, np.float32)
+            order = np.argsort(-dets[:, 1])[:keep_top_k]
+            dets = dets[order]
+            idxs = np.asarray(idxs)[order]
+        else:
+            dets = np.zeros((0, 6), np.float32)
+            idxs = np.zeros((0,), np.int64)
+        outs.append(dets)
+        indices.append(idxs + b * sv.shape[2] if idxs.size else idxs)
+        nums.append(len(dets))
+    out = Tensor(jnp.asarray(np.concatenate(outs) if outs else
+                             np.zeros((0, 6), np.float32)))
+    rois_num = Tensor(jnp.asarray(np.asarray(nums, np.int32)))
+    index = Tensor(jnp.asarray(np.concatenate(indices).astype(np.int64)
+                               if indices else np.zeros((0,), np.int64)))
+    result = [out]
+    if return_index:
+        result.append(index)
+    if return_rois_num:
+        result.append(rois_num)
+    return tuple(result) if len(result) > 1 else out

@@ -226,3 +226,10 @@ class BrightnessTransform:
         arr = _as_np(img).astype(np.float32)
         alpha = 1 + np.random.uniform(-self.value, self.value)
         return np.clip(arr * alpha, 0, 255 if arr.max() > 1.5 else 1.0)
+
+from ._extra import (  # noqa: E402,F401
+    BaseTransform, ColorJitter, ContrastTransform, Grayscale, HueTransform,
+    RandomAffine, RandomErasing, RandomPerspective, RandomRotation,
+    SaturationTransform, adjust_brightness, adjust_contrast, adjust_hue,
+    adjust_saturation, affine, erase, pad, perspective, rotate, to_grayscale,
+)

@@ -1,0 +1,400 @@
+"""Round-5 transforms tail. Reference: python/paddle/vision/transforms/
+(transforms.py + functional.py) — color ops, geometric warps (PIL backend,
+matching the reference's default), random augmentations.
+
+Convention follows the existing module: numpy HWC arrays in/out (PIL images
+accepted), uint8 [0,255] or float [0,1]."""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+
+def _as_np(img):
+    return np.asarray(img)
+
+
+def _is_float(arr):
+    return arr.dtype.kind == "f" and arr.max() <= 1.5
+
+
+def _to_pil(img):
+    from PIL import Image
+
+    arr = _as_np(img)
+    if arr.dtype.kind == "f":
+        arr = (np.clip(arr, 0, 1) * 255).astype(np.uint8)
+    if arr.ndim == 3 and arr.shape[2] == 1:
+        arr = arr[:, :, 0]
+    return Image.fromarray(arr)
+
+
+def _from_pil(pil, like):
+    arr = np.asarray(pil)
+    ref = _as_np(like)
+    if arr.ndim == 2 and ref.ndim == 3:
+        arr = arr[:, :, None]
+    if ref.dtype.kind == "f" and ref.max() <= 1.5:
+        arr = arr.astype(np.float32) / 255.0
+    return arr
+
+
+# ------------------------------------------------------------- color functional
+def adjust_brightness(img, brightness_factor):
+    """Reference functional.py adjust_brightness: img * factor."""
+    arr = _as_np(img).astype(np.float32)
+    hi = 1.0 if _is_float(_as_np(img)) else 255.0
+    out = np.clip(arr * brightness_factor, 0, hi)
+    return out if hi == 1.0 else out.astype(_as_np(img).dtype)
+
+
+def adjust_contrast(img, contrast_factor):
+    """Blend with the grayscale mean."""
+    arr = _as_np(img).astype(np.float32)
+    hi = 1.0 if _is_float(_as_np(img)) else 255.0
+    gray = arr.mean(axis=tuple(range(arr.ndim)), keepdims=False) if arr.ndim == 2 \
+        else (arr[..., :3] @ np.asarray([0.299, 0.587, 0.114], np.float32)).mean()
+    out = np.clip((1 - contrast_factor) * gray + contrast_factor * arr, 0, hi)
+    return out if hi == 1.0 else out.astype(_as_np(img).dtype)
+
+
+def adjust_saturation(img, saturation_factor):
+    """Blend with the per-pixel grayscale."""
+    arr = _as_np(img).astype(np.float32)
+    hi = 1.0 if _is_float(_as_np(img)) else 255.0
+    gray = arr[..., :3] @ np.asarray([0.299, 0.587, 0.114], np.float32)
+    out = np.clip((1 - saturation_factor) * gray[..., None]
+                  + saturation_factor * arr, 0, hi)
+    return out if hi == 1.0 else out.astype(_as_np(img).dtype)
+
+
+def adjust_hue(img, hue_factor):
+    """Rotate hue by hue_factor (in [-0.5, 0.5]) via HSV."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    src = _as_np(img)
+    pil = _to_pil(img).convert("HSV")
+    h, s, v = pil.split()
+    h_arr = np.asarray(h, np.int16)
+    h_arr = ((h_arr + int(hue_factor * 255)) % 256).astype(np.uint8)
+    from PIL import Image
+
+    out = Image.merge("HSV", (Image.fromarray(h_arr, "L"), s, v)).convert("RGB")
+    return _from_pil(out, src)
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = _as_np(img).astype(np.float32)
+    gray = arr[..., :3] @ np.asarray([0.299, 0.587, 0.114], np.float32)
+    out = np.repeat(gray[..., None], num_output_channels, axis=-1)
+    return out if _is_float(_as_np(img)) else out.astype(_as_np(img).dtype)
+
+
+# --------------------------------------------------------- geometric functional
+def _interp(mode):
+    from PIL import Image
+
+    return {"nearest": Image.NEAREST, "bilinear": Image.BILINEAR,
+            "bicubic": Image.BICUBIC}.get(mode, Image.NEAREST)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Reference functional.py rotate (PIL backend)."""
+    pil = _to_pil(img)
+    out = pil.rotate(angle, resample=_interp(interpolation), expand=expand,
+                     center=center, fillcolor=fill)
+    return _from_pil(out, img)
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """Reference functional.py affine: rotation+translation+scale+shear about
+    the center (inverse-matrix form PIL consumes)."""
+    import math
+
+    arr = _as_np(img)
+    h, w = arr.shape[0], arr.shape[1]
+    cx, cy = center if center is not None else (w * 0.5, h * 0.5)
+    rot = math.radians(angle)
+    sx, sy = [math.radians(s) for s in (shear if isinstance(shear, (list, tuple))
+                                        else (shear, 0.0))]
+    # forward matrix M = T(center) R S Shear T(-center) T(translate)
+    a = math.cos(rot - sy) / math.cos(sy)
+    b = -math.cos(rot - sy) * math.tan(sx) / math.cos(sy) - math.sin(rot)
+    c = math.sin(rot - sy) / math.cos(sy)
+    d = -math.sin(rot - sy) * math.tan(sx) / math.cos(sy) + math.cos(rot)
+    M = np.asarray([[a, b, 0.0], [c, d, 0.0], [0, 0, 1]], np.float64) * scale
+    M[2, 2] = 1.0
+    T1 = np.asarray([[1, 0, cx + translate[0]], [0, 1, cy + translate[1]],
+                     [0, 0, 1]], np.float64)
+    T2 = np.asarray([[1, 0, -cx], [0, 1, -cy], [0, 0, 1]], np.float64)
+    fwd = T1 @ M @ T2
+    inv = np.linalg.inv(fwd)
+    pil = _to_pil(img)
+    from PIL import Image
+
+    out = pil.transform((w, h), Image.AFFINE,
+                        (inv[0, 0], inv[0, 1], inv[0, 2],
+                         inv[1, 0], inv[1, 1], inv[1, 2]),
+                        resample=_interp(interpolation), fillcolor=fill)
+    return _from_pil(out, img)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest", fill=0):
+    """Reference functional.py perspective: warp mapping endpoints back onto
+    startpoints (PIL PERSPECTIVE coefficients solved least-squares)."""
+    a = []
+    b = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        a.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        a.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        b.extend([sx, sy])
+    coeffs = np.linalg.lstsq(np.asarray(a, np.float64),
+                             np.asarray(b, np.float64), rcond=None)[0]
+    pil = _to_pil(img)
+    from PIL import Image
+
+    h, w = _as_np(img).shape[:2]
+    out = pil.transform((w, h), Image.PERSPECTIVE, tuple(coeffs),
+                        resample=_interp(interpolation), fillcolor=fill)
+    return _from_pil(out, img)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Reference functional.py erase: overwrite the (i:i+h, j:j+w) patch."""
+    arr = _as_np(img)
+    out = arr if inplace else arr.copy()
+    out[i:i + h, j:j + w] = v
+    return out
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    """Reference functional.py pad (left/top/right/bottom int or tuple)."""
+    arr = _as_np(img)
+    p = padding
+    if isinstance(p, numbers.Number):
+        p = (p, p, p, p)
+    elif len(p) == 2:
+        p = (p[0], p[1], p[0], p[1])
+    widths = ((p[1], p[3]), (p[0], p[2])) + ((0, 0),) * (arr.ndim - 2)
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    return np.pad(arr, widths, mode=mode, **kw)
+
+
+# ----------------------------------------------------------- transform classes
+class BaseTransform:
+    """Reference transforms.py BaseTransform — keys-aware callable: applies
+    _apply_image (and friends) to each element per `keys`."""
+
+    def __init__(self, keys=None):
+        self.keys = keys or ("image",)
+
+    def _get_params(self, inputs):
+        return None
+
+    def _apply_image(self, image):
+        raise NotImplementedError
+
+    def __call__(self, inputs):
+        if isinstance(inputs, tuple):
+            outs = []
+            for key, data in zip(self.keys, inputs):
+                fn = getattr(self, f"_apply_{key}", None)
+                outs.append(fn(data) if fn else data)
+            return tuple(outs)
+        return self._apply_image(inputs)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if value < 0:
+            raise ValueError("contrast value must be non-negative")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return adjust_contrast(img,
+                               np.random.uniform(max(0, 1 - self.value),
+                                                 1 + self.value))
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if value < 0:
+            raise ValueError("saturation value must be non-negative")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return adjust_saturation(img,
+                                 np.random.uniform(max(0, 1 - self.value),
+                                                   1 + self.value))
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return adjust_hue(img, np.random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    """Reference transforms.py ColorJitter — random order of the four color
+    perturbations."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.brightness = brightness
+        self.contrast = contrast
+        self.saturation = saturation
+        self.hue = hue
+
+    def _apply_image(self, img):
+        from . import BrightnessTransform
+
+        ops = []
+        if self.brightness:
+            ops.append(BrightnessTransform(self.brightness))
+        if self.contrast:
+            ops.append(ContrastTransform(self.contrast))
+        if self.saturation:
+            ops.append(SaturationTransform(self.saturation))
+        if self.hue:
+            ops.append(HueTransform(self.hue))
+        for i in np.random.permutation(len(ops)):
+            img = ops[int(i)](img)
+        return img
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.interpolation = interpolation
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = np.random.uniform(*self.degrees)
+        return rotate(img, angle, self.interpolation, self.expand,
+                      self.center, self.fill)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        h, w = _as_np(img).shape[:2]
+        angle = np.random.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = np.random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1], self.translate[1]) * h
+        scale = np.random.uniform(*self.scale) if self.scale else 1.0
+        shear = 0.0
+        if self.shear is not None:
+            sh = self.shear
+            if isinstance(sh, numbers.Number):
+                sh = (-abs(sh), abs(sh))
+            shear = np.random.uniform(sh[0], sh[1])
+        return affine(img, angle, (tx, ty), scale, shear,
+                      self.interpolation, self.fill, self.center)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return _as_np(img)
+        h, w = _as_np(img).shape[:2]
+        d = self.distortion_scale
+        half_h, half_w = int(h * d / 2), int(w * d / 2)
+
+        def r(lo, hi):
+            return int(np.random.randint(lo, max(lo + 1, hi)))
+
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [(r(0, half_w), r(0, half_h)),
+               (w - 1 - r(0, half_w), r(0, half_h)),
+               (w - 1 - r(0, half_w), h - 1 - r(0, half_h)),
+               (r(0, half_w), h - 1 - r(0, half_h))]
+        return perspective(img, start, end, self.interpolation, self.fill)
+
+
+class RandomErasing(BaseTransform):
+    """Reference transforms.py RandomErasing (Zhong et al.)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+
+    def _apply_image(self, img):
+        arr = _as_np(img)
+        if np.random.rand() >= self.prob:
+            return arr
+        h, w = arr.shape[0], arr.shape[1]
+        area = h * w
+        for _ in range(10):
+            target = np.random.uniform(*self.scale) * area
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            eh = int(round(np.sqrt(target * ar)))
+            ew = int(round(np.sqrt(target / ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh)
+                j = np.random.randint(0, w - ew)
+                v = (np.random.randn(eh, ew, *arr.shape[2:])
+                     if self.value == "random" else self.value)
+                return erase(arr, i, j, eh, ew, v, self.inplace)
+        return arr
