@@ -24,6 +24,7 @@ from .compat import (  # noqa: F401
 from .entry_attr import (  # noqa: F401
     CountFilterEntry, ProbabilityEntry, ShowClickEntry,
 )
+from .fleet_dataset import InMemoryDataset, QueueDataset  # noqa: F401
 from . import io  # noqa: F401
 from .parallel import DataParallel  # noqa: F401
 from . import auto_parallel  # noqa: F401
