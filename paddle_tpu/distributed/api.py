@@ -115,7 +115,7 @@ def _resolve_partial(val, src_mesh, src_placements, partial_axes):
     key = (src_mesh, tuple(src_placements), axis_ops, ndim)
     reducer = _PARTIAL_REDUCERS.get(key)
     if reducer is None:
-        from jax import shard_map as _smap
+        from .collective import shard_map_unchecked
 
         in_spec = spec_for(src_mesh, src_placements, ndim)
 
@@ -129,8 +129,7 @@ def _resolve_partial(val, src_mesh, src_placements, partial_axes):
             return v
 
         reducer = jax.jit(
-            _smap(_reduce, mesh=src_mesh.jax_mesh, in_specs=in_spec,
-                  out_specs=in_spec, check_vma=False))
+            shard_map_unchecked(_reduce, src_mesh.jax_mesh, in_spec, in_spec))
         _PARTIAL_REDUCERS[key] = reducer
     return reducer(val)
 

@@ -28,6 +28,22 @@ from ..tensor import Tensor
 from . import env
 
 
+def shard_map_unchecked(fn, mesh, in_specs, out_specs):
+    """shard_map with the value-replication check off: collective results
+    (all_gather/psum) are replicated across the axis but jax's
+    varying-manual-axes check cannot infer that for replicated out_specs like
+    P(None); the collectives themselves guarantee it. The disabling kwarg was
+    renamed check_rep -> check_vma across jax releases — support both."""
+    from jax.experimental.shard_map import shard_map as _smap
+
+    try:
+        return _smap(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     check_vma=False)
+    except TypeError:
+        return _smap(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     check_rep=False)
+
+
 class ReduceOp:
     SUM = "sum"
     MAX = "max"
@@ -90,14 +106,8 @@ class Group:
         """Run `fn` SPMD over this group's mesh (per-shard view; collectives on
         self.axis_name work inside). The TPU-native stand-in for 'code running
         on every rank of the group'."""
-        from jax import shard_map as _smap
-
-        # check_vma=False: collective results (all_gather/psum) are replicated
-        # across the axis but jax's varying-manual-axes check cannot infer that
-        # for replicated out_specs like P(None); the collectives themselves
-        # guarantee it.
-        return jax.jit(_smap(fn, mesh=self.jax_mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=False))
+        return jax.jit(shard_map_unchecked(fn, self.jax_mesh, in_specs,
+                                           out_specs))
 
 
 _default_group: Group | None = None

@@ -117,3 +117,10 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+from .kv_cache import (  # noqa: E402,F401  (serving-layer paged KV cache)
+    BlockAllocator,
+    CacheOutOfBlocks,
+    PagedKVCache,
+)

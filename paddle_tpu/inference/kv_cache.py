@@ -1,0 +1,206 @@
+"""Block-paged KV cache for the serving layer (PagedAttention-style).
+
+Reference role: paddle/phi/kernels/fusion/gpu/block_multi_head_attention_
+kernel.cu + the BlockManager half of vLLM's design (Kwon et al., SOSP 2023).
+TPU-native shape: one shared per-layer page pool on device ([num_blocks,
+block_size, Hkv, D]); each request owns a block TABLE (host ints) handed to
+the paged decode-attention kernel (ops/pallas/decode_attention.py), which
+reads pages through a scalar-prefetched index map — no gather
+materialization. Mixed-length requests in a batch therefore hold
+ceil(len/block_size) blocks each instead of every request padding to the
+server-wide max length.
+
+Host side (this file) is pure bookkeeping: a free-list allocator with LIFO
+reuse (hot pages stay hot), per-request tables/lengths, and LRU eviction of
+finished-but-retained requests when the pool runs dry.
+"""
+from __future__ import annotations
+
+import itertools
+import math
+
+import numpy as np
+
+__all__ = ["CacheOutOfBlocks", "BlockAllocator", "PagedKVCache"]
+
+
+class CacheOutOfBlocks(RuntimeError):
+    """The pool cannot satisfy an allocation even after eviction."""
+
+
+class BlockAllocator:
+    """Fixed-population free-list block allocator.
+
+    LIFO reuse: the most recently freed block is handed out first, so a busy
+    serving loop keeps touching the same hot pages instead of sweeping the
+    whole pool."""
+
+    def __init__(self, num_blocks: int):
+        self.num_blocks = int(num_blocks)
+        self._free = list(range(self.num_blocks - 1, -1, -1))
+        self._live: set[int] = set()
+
+    @property
+    def available(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    def allocate(self, n: int) -> list[int]:
+        if n > len(self._free):
+            raise CacheOutOfBlocks(
+                f"need {n} blocks, {len(self._free)} free of {self.num_blocks}")
+        out = self._free[-n:][::-1]
+        del self._free[len(self._free) - n:]
+        self._live.update(out)
+        return out
+
+    def free(self, blocks) -> None:
+        blocks = list(blocks)
+        for b in blocks:
+            if not (0 <= b < self.num_blocks):
+                raise ValueError(f"block {b} outside pool")
+            if b not in self._live:
+                raise ValueError(f"double free of block {b} (not live)")
+        self._live.difference_update(blocks)
+        self._free.extend(blocks)
+
+
+class _Request:
+    __slots__ = ("blocks", "length", "done", "touch")
+
+    def __init__(self, blocks, length, touch):
+        self.blocks = blocks
+        self.length = length
+        self.done = False
+        self.touch = touch
+
+
+class PagedKVCache:
+    """Shared device page pool + per-request block tables.
+
+    The pools are plain jax arrays (functional): a compiled decode program
+    takes them as inputs and returns the updated pools, which the caller
+    stores back via commit() — the same discipline TrainStep uses for
+    parameters. Everything else (tables, lengths, eviction) is host state.
+    """
+
+    def __init__(self, num_layers, num_kv_heads, head_dim, block_size=128,
+                 num_blocks=64, dtype="bfloat16"):
+        import jax.numpy as jnp
+
+        self.num_layers = int(num_layers)
+        self.num_kv_heads = int(num_kv_heads)
+        self.head_dim = int(head_dim)
+        self.block_size = int(block_size)
+        self.num_blocks = int(num_blocks)
+        self.dtype = jnp.dtype(dtype)
+        # head-leading [Hkv, P, BS, D]: the paged kernel resolves the head
+        # axis in its index_map, so pages stream as contiguous [BS, D] tiles
+        shape = (self.num_kv_heads, self.num_blocks, self.block_size,
+                 self.head_dim)
+        self.k_pages = [jnp.zeros(shape, self.dtype)
+                        for _ in range(self.num_layers)]
+        self.v_pages = [jnp.zeros(shape, self.dtype)
+                        for _ in range(self.num_layers)]
+        self.allocator = BlockAllocator(self.num_blocks)
+        self._requests: dict = {}
+        self._clock = itertools.count()
+
+    # ------------------------------------------------------------- identity
+    def signature(self):
+        """Hashable shape identity for compiled-runner cache keys."""
+        return (self.num_layers, self.num_kv_heads, self.head_dim,
+                self.block_size, self.num_blocks, str(self.dtype))
+
+    def blocks_for(self, seq_len: int) -> int:
+        return max(1, math.ceil(seq_len / self.block_size))
+
+    # ----------------------------------------------------------- allocation
+    def reserve(self, request_id, max_seq_len: int, evict: bool = True):
+        """Allocate blocks covering max_seq_len for a new request; returns the
+        block table as int32 [num_blocks_for(max_seq_len)]. When the free list
+        runs dry and `evict`, finished-but-retained requests are evicted
+        least-recently-used first."""
+        if request_id in self._requests:
+            raise ValueError(f"request {request_id!r} already reserved")
+        n = self.blocks_for(max_seq_len)
+        if evict and self.allocator.available < n:
+            self._evict_lru(n - self.allocator.available)
+        blocks = self.allocator.allocate(n)  # raises CacheOutOfBlocks
+        self._requests[request_id] = _Request(blocks, 0, next(self._clock))
+        return np.asarray(blocks, np.int32)
+
+    def _evict_lru(self, need: int):
+        done = sorted((r for r in self._requests.items() if r[1].done),
+                      key=lambda kv: kv[1].touch)
+        freed = 0
+        for rid, req in done:
+            if freed >= need:
+                break
+            freed += len(req.blocks)
+            self.release(rid)
+
+    def mark_done(self, request_id):
+        """Request finished decoding; its pages stay readable (gather) but
+        become evictable when the pool needs room."""
+        self._requests[request_id].done = True
+
+    def release(self, request_id):
+        req = self._requests.pop(request_id)
+        self.allocator.free(req.blocks)
+
+    # ------------------------------------------------------------- metadata
+    def block_table(self, request_id, pad_to=None):
+        """int32 table of page ids; padded with page 0 (fetched-but-masked —
+        the kernel requires valid page ids in dead slots)."""
+        req = self._requests[request_id]
+        req.touch = next(self._clock)
+        tbl = list(req.blocks)
+        if pad_to is not None:
+            tbl += [0] * (int(pad_to) - len(tbl))
+        return np.asarray(tbl, np.int32)
+
+    def length(self, request_id) -> int:
+        return self._requests[request_id].length
+
+    def set_length(self, request_id, n: int):
+        req = self._requests[request_id]
+        if n > len(req.blocks) * self.block_size:
+            raise ValueError(
+                f"length {n} exceeds reserved capacity "
+                f"{len(req.blocks) * self.block_size}")
+        req.length = int(n)
+
+    @property
+    def blocks_in_use(self) -> int:
+        return self.allocator.in_use
+
+    @property
+    def utilization(self) -> float:
+        return self.allocator.in_use / self.num_blocks
+
+    # ------------------------------------------------------------ device I/O
+    def commit(self, k_pages, v_pages):
+        """Store the pools a compiled step returned (functional update)."""
+        if len(k_pages) != self.num_layers or len(v_pages) != self.num_layers:
+            raise ValueError("pool list length != num_layers")
+        self.k_pages = list(k_pages)
+        self.v_pages = list(v_pages)
+
+    def gather(self, request_id, layer: int):
+        """Host-side contiguous [length, Hkv, D] (k, v) view of a request's
+        cache — debug/audit path; the kernel never gathers."""
+        req = self._requests[request_id]
+        n = self.blocks_for(max(req.length, 1))
+        tbl = np.asarray(req.blocks[:n])
+
+        def _dense(pages):
+            # [Hkv, n, BS, D] -> [n*BS, Hkv, D]
+            arr = np.asarray(pages)[:, tbl]
+            arr = arr.reshape(self.num_kv_heads, -1, self.head_dim)
+            return arr.swapaxes(0, 1)[:req.length]
+
+        return _dense(self.k_pages[layer]), _dense(self.v_pages[layer])

@@ -1,0 +1,305 @@
+"""Shared autoregressive decoding for the causal-LM models (GPT, LLaMA).
+
+TPU-native shape (carried over from the round-5 GPT serving work): prefill is
+one compiled program; the ENTIRE decode loop is a second compiled program
+(`lax.scan` over steps) — no per-token host round-trips, which dominate
+wall-clock on remote/async dispatch. KV caches materialize INSIDE the program
+(host-side per-call cache allocation measured ~1.4 s/call through the tunneled
+device plugin — 83% of round-4's e2e serving wall).
+
+Two cache layouts:
+  * dense — per-request [B, max_len, Hkv, D] caches allocated in-program
+    (the `generate()` path; one contiguous cache per batch slot).
+  * paged — a shared page pool [num_pages, block_size, Hkv, D] addressed
+    through per-request block tables (the `generate_paged()` path; serving
+    hands in a paddle_tpu.inference.kv_cache.PagedKVCache so mixed-length
+    requests share cache memory instead of each padding to max length).
+
+Attention over the cache goes through ops/pallas/decode_attention behind the
+`decode_kernel` flag: "xla" (grouped-GQA einsum — the correctness reference)
+or "pallas" (split-KV flash-decode kernel). Dense defaults to "xla" (the
+measured serving baseline); paged defaults to "pallas" (the XLA paged path
+re-gathers the pool into a dense cache every step).
+
+Models plug in via three hooks:
+  _decode_layer()      -> Layer whose functional_call accepts
+                          (ids, caches=, cache_offset=, decode_kernel=,
+                          paged_tables=, cache_valid=) and returns
+                          (logits, new_caches)
+  _decode_cache_spec() -> (num_layers, num_kv_heads, head_dim)
+  _decode_validate(prompt_len, max_new_tokens) -> None (raise on invalid)
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+
+
+class GenerationMixin:
+    # ------------------------------------------------------------- state cast
+    def _decode_state(self, dtype):
+        """Model state cast (once) to the decode dtype, cached by parameter
+        buffer identity. Decode at B<=8 is weight-streaming-bound: f32 weights
+        cost ~2x the HBM traffic AND trigger the TPU's multi-pass f32 matmul
+        (measured ~7 GB/token vs ~0.9 GB in bf16 — the round-3 9 tok/s decode
+        was exactly this), so bf16 state is the serving default."""
+        state = self.model_state_raw()
+        if dtype is None:
+            return state
+        src = tuple(state.values())
+        cached = getattr(self, "_decode_state_bf16", None)
+        # identity check against RETAINED source arrays (an id()-only key
+        # could collide after CPython recycles freed addresses post-update)
+        if (cached is not None and cached[0] == dtype
+                and len(cached[1]) == len(src)
+                and all(a is b for a, b in zip(cached[1], src))):
+            return cached[2]
+        cast = {k: (v.astype(dtype) if v.dtype == jnp.float32 else v)
+                for k, v in state.items()}
+        self._decode_state_bf16 = (dtype, src, cast)
+        return cast
+
+    def model_state_raw(self):
+        """raw state keyed as the decode layer sees it (functional_call)."""
+        return self._decode_layer().raw_state()
+
+    # ------------------------------------------------------------- internals
+    def _decode_call(self, raw_state, tok_ids, caches, offset, decode_kernel,
+                     paged_tables=None, cache_valid=None):
+        """One functional model call over raw jax values -> (logits, caches)."""
+        kwargs = dict(cache_offset=offset, decode_kernel=decode_kernel)
+        if paged_tables is not None:
+            kwargs.update(paged_tables=paged_tables, cache_valid=cache_valid)
+        out = self._decode_layer().functional_call(
+            raw_state, Tensor(tok_ids),
+            caches=[(Tensor(k), Tensor(v)) for k, v in caches], **kwargs)
+        logits, new_caches = out
+        lg = logits._value if isinstance(logits, Tensor) else logits
+        nc = [
+            (kc._value if isinstance(kc, Tensor) else kc,
+             vc._value if isinstance(vc, Tensor) else vc)
+            for kc, vc in new_caches
+        ]
+        return lg, nc
+
+    @staticmethod
+    def _make_sampler(greedy, temperature, top_k, eos, ids_dtype):
+        def sample(lg, key, finished):
+            if greedy:
+                nxt = jnp.argmax(lg.astype(jnp.float32), axis=-1)
+            else:
+                lg = lg.astype(jnp.float32) / jnp.float32(temperature)
+                if top_k and top_k > 0:
+                    kth = jax.lax.top_k(lg, top_k)[0][:, -1:]
+                    lg = jnp.where(lg < kth, jnp.finfo(jnp.float32).min, lg)
+                key, sub = jax.random.split(key)
+                nxt = jax.random.categorical(sub, lg, axis=-1)
+            nxt = nxt.astype(ids_dtype)
+            if eos >= 0:
+                nxt = jnp.where(finished, eos, nxt)
+                finished = finished | (nxt == eos)
+            return nxt, key, finished
+
+        return sample
+
+    def _runner_cache(self):
+        cache = getattr(self, "_generate_cache", None)
+        if cache is None:
+            cache = self._generate_cache = {}
+        return cache
+
+    # ------------------------------------------------------------ dense path
+    def generate(self, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
+                 eos_token_id=None, seed=0, dtype="bfloat16",
+                 decode_kernel=None):
+        """Autoregressive decoding with dense per-layer KV caches.
+
+        temperature==0 -> greedy; otherwise softmax sampling with optional
+        top-k truncation; eos positions freeze once hit. Returns
+        [B, prompt+new] ids.
+
+        `dtype`: decode compute dtype for weights + KV caches ('bfloat16'
+        default — decode is weight-streaming-bound, see _decode_state; pass
+        None to keep the parameters' own dtype).
+        `decode_kernel`: "xla" (default — grouped-GQA einsum) | "pallas"
+        (split-KV flash-decode kernel, ops/pallas/decode_attention.py).
+        """
+        ids = (input_ids._value if isinstance(input_ids, Tensor)
+               else jnp.asarray(input_ids))
+        B, P = ids.shape
+        self._decode_validate(P, max_new_tokens)
+        num_layers, kv_h, hd = self._decode_cache_spec()
+        max_len = P + max_new_tokens
+        decode_dtype = None if dtype is None else jnp.dtype(dtype)
+        cache_dtype = decode_dtype or jnp.float32
+        state = self._decode_state(decode_dtype)
+        ids_dtype = ids.dtype  # closure must not pin the prompt array itself
+        greedy = not (temperature and temperature > 0)
+        eos = -1 if eos_token_id is None else int(eos_token_id)
+        sample = self._make_sampler(greedy, temperature, top_k, eos, ids_dtype)
+
+        def make_run():
+            @jax.jit
+            def run(raw_state, prompt, key):
+                # head-leading [B, Hkv, T, D]: the decode kernel's
+                # DMA-contiguous layout (ops/pallas/decode_attention.py)
+                caches = [
+                    (jnp.zeros((B, kv_h, max_len, hd), cache_dtype),
+                     jnp.zeros((B, kv_h, max_len, hd), cache_dtype))
+                    for _ in range(num_layers)
+                ]
+                logits, caches = self._decode_call(
+                    raw_state, prompt, caches, jnp.int32(0), decode_kernel)
+                finished = jnp.zeros((B,), bool)
+                tok0, key, finished = sample(logits[:, -1], key, finished)
+
+                def body(carry, t):
+                    tok, caches, key, finished = carry
+                    lg, caches = self._decode_call(
+                        raw_state, tok[:, None], caches,
+                        (P + t).astype(jnp.int32), decode_kernel)
+                    nxt, key, finished = sample(lg[:, -1], key, finished)
+                    return (nxt, caches, key, finished), nxt
+
+                if max_new_tokens > 1:
+                    (_, _, _, _), toks = jax.lax.scan(
+                        body, (tok0, caches, key, finished),
+                        jnp.arange(max_new_tokens - 1))
+                    toks = jnp.concatenate([tok0[None], toks], axis=0)
+                else:
+                    toks = tok0[None]
+                # prompt+new concatenated in-program: one result fetch, no
+                # extra host-side dispatch per call
+                return jnp.concatenate([prompt, jnp.swapaxes(toks, 0, 1)],
+                                       axis=1)
+
+            return run
+
+        # jit caches on function identity: rebuilding the closure per call
+        # would recompile prefill + the whole decode scan on every request
+        cache_key = (B, P, max_new_tokens, greedy, float(temperature or 0.0),
+                     int(top_k or 0), eos, str(ids.dtype), str(decode_dtype),
+                     decode_kernel)
+        run_cache = self._runner_cache()
+        run = run_cache.get(cache_key)
+        if run is None:
+            run = run_cache[cache_key] = make_run()
+
+        was_training = self.training
+        self.eval()
+        try:
+            return Tensor(run(state, ids, jax.random.key(seed)))
+        finally:
+            if was_training:
+                self.train()
+
+    def compiled_generate_runner(self, batch, prompt_len, max_new_tokens):
+        """The cached compiled (state, prompt, key) -> ids program for a prior
+        generate() shape, or None. Public so benches/audits can time the
+        compiled program itself without depending on the cache-key layout."""
+        for k, run in (getattr(self, "_generate_cache", None) or {}).items():
+            if k[:3] == (batch, prompt_len, max_new_tokens):
+                return run
+        return None
+
+    # ------------------------------------------------------------ paged path
+    def generate_paged(self, input_ids, prompt_lens, kv_cache, block_tables,
+                       max_new_tokens=32, temperature=0.0, top_k=0,
+                       eos_token_id=None, seed=0, decode_kernel="pallas"):
+        """Autoregressive decoding over a SHARED paged KV pool.
+
+        input_ids: [B, P] prompts right-padded to a common P; prompt_lens [B]
+        gives each request's true length (padding rows are dropped from the
+        cache by the out-of-bounds-scatter trick and masked from attention by
+        per-request lengths). kv_cache: a PagedKVCache whose per-layer pools
+        this program reads AND returns updated (committed back on exit).
+        block_tables: [B, NB] page ids from the pool's allocator.
+
+        Returns [B, max_new_tokens] new tokens (per request b the real
+        continuation of input_ids[b, :prompt_lens[b]]).
+        """
+        ids = (input_ids._value if isinstance(input_ids, Tensor)
+               else jnp.asarray(input_ids))
+        B, P = ids.shape
+        self._decode_validate(P, max_new_tokens)
+        decode_dtype = (jnp.dtype(kv_cache.dtype)
+                        if kv_cache.dtype != jnp.float32 else None)
+        state = self._decode_state(decode_dtype)
+        ids_dtype = ids.dtype
+        greedy = not (temperature and temperature > 0)
+        eos = -1 if eos_token_id is None else int(eos_token_id)
+        sample = self._make_sampler(greedy, temperature, top_k, eos, ids_dtype)
+        NB = int(block_tables.shape[1])
+
+        def make_run():
+            # donate the pools on accelerators: XLA aliases them in place so
+            # the program never holds two copies of the page pool (donation is
+            # unimplemented on CPU and would only warn there)
+            try:
+                donate = (4, 5) if jax.default_backend() != "cpu" else ()
+            except Exception:
+                donate = ()
+
+            @functools.partial(jax.jit, donate_argnums=donate)
+            def run(raw_state, prompt, plens, tables, k_pages, v_pages, key):
+                plens = plens.astype(jnp.int32)
+                caches = list(zip(k_pages, v_pages))
+                valid = (jnp.arange(P, dtype=jnp.int32)[None, :]
+                         < plens[:, None])
+                # prefill at per-request offset 0; padding rows write nothing
+                logits, caches = self._decode_call(
+                    raw_state, prompt, caches, jnp.zeros((B,), jnp.int32),
+                    decode_kernel, paged_tables=tables, cache_valid=valid)
+                last = jnp.take_along_axis(
+                    logits, (plens - 1)[:, None, None].astype(jnp.int32),
+                    axis=1)[:, 0]
+                finished = jnp.zeros((B,), bool)
+                tok0, key, finished = sample(last, key, finished)
+                lengths = plens
+
+                def body(carry, _):
+                    tok, caches, lengths, key, finished = carry
+                    lg, caches = self._decode_call(
+                        raw_state, tok[:, None], caches, lengths,
+                        decode_kernel, paged_tables=tables, cache_valid=None)
+                    nxt, key, finished = sample(lg[:, -1], key, finished)
+                    return (nxt, caches, lengths + 1, key, finished), nxt
+
+                if max_new_tokens > 1:
+                    (_, caches, _, _, _), toks = jax.lax.scan(
+                        body, (tok0, caches, lengths + 1, key, finished),
+                        jnp.arange(max_new_tokens - 1))
+                    toks = jnp.concatenate([tok0[None], toks], axis=0)
+                else:
+                    toks = tok0[None]
+                new_k = [kc for kc, _ in caches]
+                new_v = [vc for _, vc in caches]
+                return jnp.swapaxes(toks, 0, 1), new_k, new_v
+
+            return run
+
+        cache_key = ("paged", B, P, max_new_tokens, NB, kv_cache.signature(),
+                     greedy, float(temperature or 0.0), int(top_k or 0), eos,
+                     str(ids.dtype), decode_kernel)
+        run_cache = self._runner_cache()
+        run = run_cache.get(cache_key)
+        if run is None:
+            run = run_cache[cache_key] = make_run()
+
+        was_training = self.training
+        self.eval()
+        try:
+            toks, new_k, new_v = run(
+                state, ids, jnp.asarray(prompt_lens, jnp.int32),
+                jnp.asarray(block_tables, jnp.int32),
+                tuple(kv_cache.k_pages), tuple(kv_cache.v_pages),
+                jax.random.key(seed))
+            kv_cache.commit(new_k, new_v)
+            return Tensor(toks)
+        finally:
+            if was_training:
+                self.train()

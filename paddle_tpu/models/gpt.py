@@ -28,6 +28,7 @@ from ..nn.layer_common import Dropout, Embedding, LayerList, Linear
 from ..nn.layer_conv_norm import LayerNorm, RMSNorm
 from ..ops import apply_op
 from ..tensor import Tensor
+from .generation import GenerationMixin
 
 
 class GPTConfig:
@@ -89,7 +90,7 @@ class GPTAttention(Layer):
                                           input_is_parallel=True)
         self.dropout = c.dropout
 
-    def forward(self, x, position_ids=None, cache=None):
+    def forward(self, x, position_ids=None, cache=None, decode_kernel=None):
         B, S = x.shape[0], x.shape[1]
         qkv = self.qkv_proj(x)
         q_size = self.num_heads * self.head_dim
@@ -106,12 +107,23 @@ class GPTAttention(Layer):
         q, k, v = apply_op(split_qkv, "split_qkv", qkv)
         if cache is not None:
             # autoregressive decode: rope at absolute positions, K/V appended
-            # into the preallocated cache, attention over the valid prefix
-            k_cache, v_cache, length = cache
+            # into the cache (dense slice or paged scatter), attention over
+            # the valid prefix via ops/pallas/decode_attention (xla reference
+            # or the split-KV Pallas kernel per `decode_kernel`)
+            paged = len(cache) == 5
+            if paged:
+                k_cache, v_cache, length, tables, valid = cache
+            else:
+                k_cache, v_cache, length = cache
             if self.use_rope and position_ids is None:
-                from ..ops.creation import arange
+                if paged:
+                    ln = length._value if isinstance(length, Tensor) else length
+                    position_ids = (jnp.asarray(ln, jnp.int32)[:, None]
+                                    + jnp.arange(S, dtype=jnp.int32)[None, :])
+                else:
+                    from ..ops.creation import arange
 
-                position_ids = arange(S) + length
+                    position_ids = arange(S) + length
             if self.use_rope:
                 from ..incubate.nn.functional import (
                     fused_rotary_position_embedding,
@@ -120,32 +132,46 @@ class GPTAttention(Layer):
                 q, k, _ = fused_rotary_position_embedding(
                     q, k, position_ids=position_ids)
 
-            def attend(qv, kv, vv, kc, vc, ln):
-                ln = ln.astype(jnp.int32) if hasattr(ln, "astype") else jnp.int32(ln)
-                zero = jnp.int32(0)
-                kc = jax.lax.dynamic_update_slice(
-                    kc, kv.astype(kc.dtype), (zero, ln, zero, zero))
-                vc = jax.lax.dynamic_update_slice(
-                    vc, vv.astype(vc.dtype), (zero, ln, zero, zero))
-                max_len = kc.shape[1]
-                rep = self.num_heads // self.num_kv_heads
-                kh = jnp.repeat(kc, rep, axis=2) if rep > 1 else kc
-                vh = jnp.repeat(vc, rep, axis=2) if rep > 1 else vc
-                scale = 1.0 / math.sqrt(self.head_dim)
-                scores = jnp.einsum("bshd,bthd->bhst", qv, kh) * scale
-                pos_q = ln + jnp.arange(S)[:, None]
-                pos_k = jnp.arange(max_len)[None, :]
-                allowed = pos_k <= pos_q          # causal over the live prefix
-                scores = jnp.where(allowed[None, None],
-                                   scores, jnp.finfo(jnp.float32).min)
-                probs = jax.nn.softmax(scores.astype(jnp.float32),
-                                       axis=-1).astype(qv.dtype)
-                out = jnp.einsum("bhst,bthd->bshd", probs, vh)
-                return out, kc, vc
+            from ..ops.pallas import decode_attention as da
 
-            out, k_cache, v_cache = apply_op(attend, "decode_attention",
-                                             q, k, v, k_cache, v_cache, length,
-                                             nout=3)
+            kernel = decode_kernel or ("pallas" if paged else "xla")
+            scale = 1.0 / math.sqrt(self.head_dim)
+
+            if paged:
+                def attend_paged(qv, kv, vv, kp, vp, tbl, ln, vld):
+                    ln = jnp.asarray(ln, jnp.int32)
+                    capacity = tbl.shape[1] * kp.shape[2]
+                    pos = da.write_positions(ln, S, valid=vld,
+                                             capacity=capacity)
+                    kp, vp = da.paged_cache_update(kp, vp, kv, vv, tbl, pos)
+                    out = da.paged_decode_attention(qv, kp, vp, tbl, ln,
+                                                    scale=scale, kernel=kernel)
+                    return out, kp, vp
+
+                out, k_cache, v_cache = apply_op(
+                    attend_paged, "paged_decode_attention",
+                    q, k, v, k_cache, v_cache, tables, length, valid, nout=3)
+            else:
+                def attend(qv, kv, vv, kc, vc, ln):
+                    ln = (ln.astype(jnp.int32) if hasattr(ln, "astype")
+                          else jnp.int32(ln))
+                    zero = jnp.int32(0)
+                    # caches are head-leading [B, Hkv, T, D] (the decode
+                    # kernel's DMA-contiguous layout); only the NEW rows
+                    # transpose, S=1 at decode
+                    kc = jax.lax.dynamic_update_slice(
+                        kc, jnp.swapaxes(kv, 1, 2).astype(kc.dtype),
+                        (zero, zero, ln, zero))
+                    vc = jax.lax.dynamic_update_slice(
+                        vc, jnp.swapaxes(vv, 1, 2).astype(vc.dtype),
+                        (zero, zero, ln, zero))
+                    out = da.decode_attention(qv, kc, vc, ln, scale=scale,
+                                              kernel=kernel)
+                    return out, kc, vc
+
+                out, k_cache, v_cache = apply_op(attend, "decode_attention",
+                                                 q, k, v, k_cache, v_cache,
+                                                 length, nout=3)
             out = out.reshape([B, S, q_size])
             return self.out_proj(out), (k_cache, v_cache)
         if self.use_rope:
@@ -193,9 +219,11 @@ class GPTBlock(Layer):
         self.mlp = GPTMLP(c)
         self.dropout = Dropout(c.dropout)
 
-    def forward(self, x, position_ids=None, cache=None):
+    def forward(self, x, position_ids=None, cache=None, decode_kernel=None):
         if cache is not None:
-            attn_out, new_kv = self.attn(self.ln1(x), position_ids, cache=cache)
+            attn_out, new_kv = self.attn(self.ln1(x), position_ids,
+                                         cache=cache,
+                                         decode_kernel=decode_kernel)
             x = x + attn_out
             x = x + self.mlp(self.ln2(x))
             return x, new_kv
@@ -220,20 +248,34 @@ class GPTModel(Layer):
             self.lm_head = ColumnParallelLinear(c.hidden_size, c.vocab_size,
                                                 has_bias=False)
 
-    def forward(self, input_ids, position_ids=None, caches=None, cache_offset=None):
+    def forward(self, input_ids, position_ids=None, caches=None, cache_offset=None,
+                decode_kernel=None, paged_tables=None, cache_valid=None):
         x = self.embed_tokens(input_ids)
         if not self.config.use_rope:
             from ..ops.creation import arange
 
             if position_ids is None:
-                start = cache_offset if cache_offset is not None else 0
-                position_ids = arange(input_ids.shape[1]) + start
+                if paged_tables is not None:
+                    # per-request offsets; padding rows clip into the table
+                    # (their logits/cache writes are dropped downstream)
+                    off = (cache_offset._value
+                           if isinstance(cache_offset, Tensor) else cache_offset)
+                    position_ids = jnp.clip(
+                        jnp.asarray(off, jnp.int32)[:, None]
+                        + jnp.arange(input_ids.shape[1], dtype=jnp.int32),
+                        0, self.config.max_position - 1)
+                else:
+                    start = cache_offset if cache_offset is not None else 0
+                    position_ids = arange(input_ids.shape[1]) + start
             x = x + self.embed_positions(position_ids)
         if caches is not None:
             new_caches = []
             for blk, (kc, vc) in zip(self.blocks, caches):
-                x, new_kv = blk(x, position_ids,
-                                cache=(kc, vc, cache_offset))
+                cache = ((kc, vc, cache_offset, paged_tables, cache_valid)
+                         if paged_tables is not None
+                         else (kc, vc, cache_offset))
+                x, new_kv = blk(x, position_ids, cache=cache,
+                                decode_kernel=decode_kernel)
                 new_caches.append(new_kv)
         else:
             x = _shard_seq(x)
@@ -259,7 +301,7 @@ class GPTModel(Layer):
         return logits
 
 
-class GPTForCausalLM(Layer):
+class GPTForCausalLM(Layer, GenerationMixin):
     def __init__(self, config: GPTConfig):
         super().__init__()
         self.gpt = GPTModel(config)
@@ -278,166 +320,22 @@ class GPTForCausalLM(Layer):
             return logits, loss
         return logits
 
-    def _decode_state(self, dtype):
-        """Model state cast (once) to the decode dtype, cached by parameter
-        buffer identity. Decode at B<=8 is weight-streaming-bound: f32 weights
-        cost ~2x the HBM traffic AND trigger the TPU's multi-pass f32 matmul
-        (measured ~7 GB/token vs ~0.9 GB in bf16 — the round-3 9 tok/s decode
-        was exactly this), so bf16 state is the serving default."""
-        state = self.model_state_raw()
-        if dtype is None:
-            return state
-        src = tuple(state.values())
-        cached = getattr(self, "_decode_state_bf16", None)
-        # identity check against RETAINED source arrays (an id()-only key
-        # could collide after CPython recycles freed addresses post-update)
-        if (cached is not None and cached[0] == dtype
-                and len(cached[1]) == len(src)
-                and all(a is b for a, b in zip(cached[1], src))):
-            return cached[2]
-        cast = {k: (v.astype(dtype) if v.dtype == jnp.float32 else v)
-                for k, v in state.items()}
-        self._decode_state_bf16 = (dtype, src, cast)
-        return cast
+    # ------------------------------------------- GenerationMixin hooks
+    def _decode_layer(self):
+        return self.gpt
 
-    def generate(self, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
-                 eos_token_id=None, seed=0, dtype="bfloat16"):
-        """Autoregressive decoding with per-layer KV caches.
-
-        TPU-native shape: prefill is one compiled program; the ENTIRE decode
-        loop is a second compiled program (`lax.scan` over steps) — no
-        per-token host round-trips, which dominate wall-clock on remote/async
-        dispatch. temperature==0 → greedy; otherwise softmax sampling with
-        optional top-k truncation; eos positions freeze once hit. Returns
-        [B, prompt+new] ids.
-
-        `dtype`: decode compute dtype for weights + KV caches ('bfloat16'
-        default — decode is weight-streaming-bound, see _decode_state; pass
-        None to keep the parameters' own dtype).
-        """
-        from ..tensor import Tensor as _T
-
+    def _decode_cache_spec(self):
         c = self.config
-        ids = (input_ids._value if isinstance(input_ids, Tensor)
-               else jnp.asarray(input_ids))
-        B, P = ids.shape
-        max_len = P + max_new_tokens
-        if not c.use_rope and max_len > c.max_position:
+        return c.num_layers, c.num_kv_heads, c.hidden_size // c.num_heads
+
+    def _decode_validate(self, prompt_len, max_new_tokens):
+        c = self.config
+        if not c.use_rope and prompt_len + max_new_tokens > c.max_position:
             # learned positions: JAX's OOB-gather clamping would silently
             # reuse the last position embedding past the table
             raise ValueError(
-                f"prompt ({P}) + max_new_tokens ({max_new_tokens}) exceeds "
-                f"max_position ({c.max_position})")
-        decode_dtype = None if dtype is None else jnp.dtype(dtype)
-        kv_h = c.num_kv_heads
-        hd = c.hidden_size // c.num_heads
-        cache_dtype = decode_dtype or jnp.float32
-        state = self._decode_state(decode_dtype)
-        ids_dtype = ids.dtype  # closure must not pin the prompt array itself
-        greedy = not (temperature and temperature > 0)
-        eos = -1 if eos_token_id is None else int(eos_token_id)
-
-        def model_step(raw_state, tok_ids, caches, offset):
-            out = self.gpt.functional_call(
-                raw_state, _T(tok_ids),
-                caches=[(_T(k), _T(v)) for k, v in caches],
-                cache_offset=offset)
-            logits_t, new_caches = out
-            lg = logits_t._value if isinstance(logits_t, Tensor) else logits_t
-            nc = [
-                (kc._value if isinstance(kc, Tensor) else kc,
-                 vc._value if isinstance(vc, Tensor) else vc)
-                for kc, vc in new_caches
-            ]
-            return lg[:, -1], nc
-
-        def sample(lg, key, finished):
-            if greedy:
-                nxt = jnp.argmax(lg.astype(jnp.float32), axis=-1)
-            else:
-                lg = lg.astype(jnp.float32) / jnp.float32(temperature)
-                if top_k and top_k > 0:
-                    kth = jax.lax.top_k(lg, top_k)[0][:, -1:]
-                    lg = jnp.where(lg < kth, jnp.finfo(jnp.float32).min, lg)
-                key, sub = jax.random.split(key)
-                nxt = jax.random.categorical(sub, lg, axis=-1)
-            nxt = nxt.astype(ids_dtype)
-            if eos >= 0:
-                nxt = jnp.where(finished, eos, nxt)
-                finished = finished | (nxt == eos)
-            return nxt, key, finished
-
-        def make_run():
-            @jax.jit
-            def run(raw_state, prompt, key):
-                # KV caches materialize INSIDE the program: 2*num_layers host
-                # dispatches of jnp.zeros per call measured ~1.4s through the
-                # tunneled device plugin — 83% of round-4's e2e serving wall
-                # (_serve_dbg.py: e2e 1664 ms/call vs 288 ms for the compiled
-                # program itself). In-program zeros are free: XLA fuses the
-                # init into the prefill's dynamic-update-slice.
-                caches = [
-                    (jnp.zeros((B, max_len, kv_h, hd), cache_dtype),
-                     jnp.zeros((B, max_len, kv_h, hd), cache_dtype))
-                    for _ in range(c.num_layers)
-                ]
-                last_logits, caches = model_step(raw_state, prompt, caches,
-                                                 jnp.int32(0))
-                finished = jnp.zeros((B,), bool)
-                tok0, key, finished = sample(last_logits, key, finished)
-
-                def body(carry, t):
-                    tok, caches, key, finished = carry
-                    lg, caches = model_step(raw_state, tok[:, None], caches,
-                                            (P + t).astype(jnp.int32))
-                    nxt, key, finished = sample(lg, key, finished)
-                    return (nxt, caches, key, finished), nxt
-
-                if max_new_tokens > 1:
-                    (_, _, _, _), toks = jax.lax.scan(
-                        body, (tok0, caches, key, finished),
-                        jnp.arange(max_new_tokens - 1))
-                    toks = jnp.concatenate([tok0[None], toks], axis=0)
-                else:
-                    toks = tok0[None]
-                # prompt+new concatenated in-program: one result fetch, no
-                # extra host-side dispatch per call
-                return jnp.concatenate([prompt, jnp.swapaxes(toks, 0, 1)],
-                                       axis=1)
-
-            return run
-
-        # jit caches on function identity: rebuilding the closure per call
-        # would recompile prefill + the whole decode scan on every request
-        cache_key = (B, P, max_new_tokens, greedy, float(temperature or 0.0),
-                     int(top_k or 0), eos, str(ids.dtype), str(decode_dtype))
-        run_cache = getattr(self, "_generate_cache", None)
-        if run_cache is None:
-            run_cache = self._generate_cache = {}
-        run = run_cache.get(cache_key)
-        if run is None:
-            run = run_cache[cache_key] = make_run()
-
-        was_training = self.training
-        self.eval()
-        try:
-            return Tensor(run(state, ids, jax.random.key(seed)))
-        finally:
-            if was_training:
-                self.train()
-
-    def compiled_generate_runner(self, batch, prompt_len, max_new_tokens):
-        """The cached compiled (state, prompt, key) -> ids program for a prior
-        generate() shape, or None. Public so benches/audits can time the
-        compiled program itself without depending on the cache-key layout."""
-        for k, run in (getattr(self, "_generate_cache", None) or {}).items():
-            if k[:3] == (batch, prompt_len, max_new_tokens):
-                return run
-        return None
-
-    def model_state_raw(self):
-        """raw state keyed as the inner GPTModel sees it (functional_call)."""
-        return self.gpt.raw_state()
+                f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_position ({c.max_position})")
 
 
 def gpt3_1p3b():

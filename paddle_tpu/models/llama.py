@@ -18,7 +18,10 @@ the in-repo reference training script target, built TPU-first like models/gpt.py
 """
 from __future__ import annotations
 
+import math
+
 import jax
+import jax.numpy as jnp
 
 from ..distributed.fleet.meta_parallel import (
     ColumnParallelLinear,
@@ -29,6 +32,9 @@ from ..nn import functional as F
 from ..nn.layer import Layer
 from ..nn.layer_common import LayerList
 from ..nn.layer_conv_norm import RMSNorm
+from ..ops import apply_op
+from ..tensor import Tensor
+from .generation import GenerationMixin
 from .gpt import _shard_seq
 
 
@@ -69,13 +75,78 @@ class LlamaAttention(Layer):
         self.o_proj = RowParallelLinear(c.hidden_size, c.hidden_size,
                                         has_bias=False, input_is_parallel=True)
 
-    def forward(self, x, position_ids=None):
+    def forward(self, x, position_ids=None, cache=None, decode_kernel=None):
         B, S = x.shape[0], x.shape[1]
         q = self.q_proj(x).reshape([B, S, self.num_heads, self.head_dim])
         k = self.k_proj(x).reshape([B, S, self.num_kv_heads, self.head_dim])
         v = self.v_proj(x).reshape([B, S, self.num_kv_heads, self.head_dim])
         from ..incubate.nn.functional import fused_rotary_position_embedding
 
+        if cache is not None:
+            # decode: rope at absolute positions, K/V into the cache (dense
+            # or paged), GQA attention over the live prefix WITHOUT expanding
+            # K/V to q heads (ops/pallas/decode_attention)
+            paged = len(cache) == 5
+            if paged:
+                k_cache, v_cache, length, tables, valid = cache
+            else:
+                k_cache, v_cache, length = cache
+            if position_ids is None:
+                if paged:
+                    ln = length._value if isinstance(length, Tensor) else length
+                    position_ids = (jnp.asarray(ln, jnp.int32)[:, None]
+                                    + jnp.arange(S, dtype=jnp.int32)[None, :])
+                else:
+                    from ..ops.creation import arange
+
+                    position_ids = arange(S) + length
+            q, k, _ = fused_rotary_position_embedding(
+                q, k, position_ids=position_ids,
+                rotary_emb_base=self.rope_theta)
+
+            from ..ops.pallas import decode_attention as da
+
+            kernel = decode_kernel or ("pallas" if paged else "xla")
+            scale = 1.0 / math.sqrt(self.head_dim)
+
+            if paged:
+                def attend_paged(qv, kv, vv, kp, vp, tbl, ln, vld):
+                    ln = jnp.asarray(ln, jnp.int32)
+                    capacity = tbl.shape[1] * kp.shape[2]
+                    pos = da.write_positions(ln, S, valid=vld,
+                                             capacity=capacity)
+                    kp, vp = da.paged_cache_update(kp, vp, kv, vv, tbl, pos)
+                    out = da.paged_decode_attention(qv, kp, vp, tbl, ln,
+                                                    scale=scale, kernel=kernel)
+                    return out, kp, vp
+
+                out, k_cache, v_cache = apply_op(
+                    attend_paged, "paged_decode_attention",
+                    q, k, v, k_cache, v_cache, tables, length, valid, nout=3)
+            else:
+                def attend(qv, kv, vv, kc, vc, ln):
+                    ln = (ln.astype(jnp.int32) if hasattr(ln, "astype")
+                          else jnp.int32(ln))
+                    zero = jnp.int32(0)
+                    # caches are head-leading [B, Hkv, T, D] (the decode
+                    # kernel's DMA-contiguous layout); only the NEW rows
+                    # transpose, S=1 at decode
+                    kc = jax.lax.dynamic_update_slice(
+                        kc, jnp.swapaxes(kv, 1, 2).astype(kc.dtype),
+                        (zero, zero, ln, zero))
+                    vc = jax.lax.dynamic_update_slice(
+                        vc, jnp.swapaxes(vv, 1, 2).astype(vc.dtype),
+                        (zero, zero, ln, zero))
+                    out = da.decode_attention(qv, kc, vc, ln, scale=scale,
+                                              kernel=kernel)
+                    return out, kc, vc
+
+                out, k_cache, v_cache = apply_op(attend, "decode_attention",
+                                                 q, k, v, k_cache, v_cache,
+                                                 length, nout=3)
+            out = self.o_proj(
+                out.reshape([B, S, self.num_heads * self.head_dim]))
+            return out, (k_cache, v_cache)
         q, k, _ = fused_rotary_position_embedding(
             q, k, position_ids=position_ids, rotary_emb_base=self.rope_theta)
         out, _ = F.flash_attention(q, k, v, causal=True, training=self.training)
@@ -106,7 +177,14 @@ class LlamaDecoderLayer(Layer):
         self.post_attention_layernorm = RMSNorm(c.hidden_size, epsilon=c.rms_eps)
         self.mlp = LlamaMLP(c)
 
-    def forward(self, x, position_ids=None):
+    def forward(self, x, position_ids=None, cache=None, decode_kernel=None):
+        if cache is not None:
+            attn_out, new_kv = self.self_attn(
+                self.input_layernorm(x), position_ids, cache=cache,
+                decode_kernel=decode_kernel)
+            x = x + attn_out
+            x = x + self.mlp(self.post_attention_layernorm(x))
+            return x, new_kv
         x = _shard_seq(x)
         x = x + self.self_attn(self.input_layernorm(x), position_ids)
         x = x + self.mlp(self.post_attention_layernorm(x))
@@ -122,8 +200,20 @@ class LlamaModel(Layer):
         self.layers = LayerList([LlamaDecoderLayer(c) for _ in range(c.num_layers)])
         self.norm = RMSNorm(c.hidden_size, epsilon=c.rms_eps)
 
-    def forward(self, input_ids, position_ids=None):
+    def forward(self, input_ids, position_ids=None, caches=None,
+                cache_offset=None, decode_kernel=None, paged_tables=None,
+                cache_valid=None):
         x = self.embed_tokens(input_ids)
+        if caches is not None:
+            new_caches = []
+            for blk, (kc, vc) in zip(self.layers, caches):
+                cache = ((kc, vc, cache_offset, paged_tables, cache_valid)
+                         if paged_tables is not None
+                         else (kc, vc, cache_offset))
+                x, new_kv = blk(x, position_ids, cache=cache,
+                                decode_kernel=decode_kernel)
+                new_caches.append(new_kv)
+            return self.norm(x), new_caches
         x = _shard_seq(x)
         remat = self.config.recompute if self.training else None
         if remat:
@@ -139,8 +229,10 @@ class LlamaModel(Layer):
         return self.norm(x)
 
 
-class LlamaForCausalLM(Layer):
-    """Untied lm_head (LLaMA-2 convention)."""
+class LlamaForCausalLM(Layer, GenerationMixin):
+    """Untied lm_head (LLaMA-2 convention). GQA makes this the model where
+    decode caching pays most: kv_heads < heads shrinks cache bytes streamed
+    per token by num_heads/num_kv_heads."""
 
     def __init__(self, config: LlamaConfig):
         super().__init__()
@@ -149,7 +241,16 @@ class LlamaForCausalLM(Layer):
         self.lm_head = ColumnParallelLinear(config.hidden_size, config.vocab_size,
                                             has_bias=False)
 
-    def forward(self, input_ids, labels=None, position_ids=None):
+    def forward(self, input_ids, labels=None, position_ids=None, caches=None,
+                cache_offset=None, decode_kernel=None, paged_tables=None,
+                cache_valid=None):
+        if caches is not None:
+            h, new_caches = self.llama(input_ids, position_ids, caches=caches,
+                                       cache_offset=cache_offset,
+                                       decode_kernel=decode_kernel,
+                                       paged_tables=paged_tables,
+                                       cache_valid=cache_valid)
+            return self.lm_head(h), new_caches
         h = self.llama(input_ids, position_ids)
         logits = self.lm_head(h)
         if labels is not None:
@@ -159,6 +260,17 @@ class LlamaForCausalLM(Layer):
                 logits.reshape([-1, logits.shape[-1]]), labels.reshape([-1]))
             return logits, per_token.mean()
         return logits
+
+    # ------------------------------------------- GenerationMixin hooks
+    def _decode_layer(self):
+        return self
+
+    def _decode_cache_spec(self):
+        c = self.config
+        return c.num_layers, c.num_kv_heads, c.hidden_size // c.num_heads
+
+    def _decode_validate(self, prompt_len, max_new_tokens):
+        pass  # rope positions extrapolate; no learned-position table to overrun
 
 
 def llama2_7b():

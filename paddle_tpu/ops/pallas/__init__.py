@@ -4,4 +4,5 @@
 Kernels run compiled on TPU and in interpreter mode elsewhere (CPU CI), so every
 kernel is testable on the virtual-device mesh without hardware.
 """
+from . import decode_attention  # noqa: F401
 from . import flash_attention  # noqa: F401

@@ -42,7 +42,9 @@ def _no_x64():
     under x64 pallas' internal index arithmetic emits i64 ops Mosaic cannot
     legalize. Kernel dtypes here are all explicit, so tracing the pallas_call
     with x64 off is semantics-preserving."""
-    return jax.enable_x64(False)
+    from jax.experimental import enable_x64
+
+    return enable_x64(False)
 
 
 # --------------------------------------------------------------------------- masks

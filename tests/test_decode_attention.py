@@ -1,0 +1,370 @@
+"""Decode-attention kernel family + paged KV cache.
+
+Covers the ISSUE-1 acceptance surface on CPU (Pallas interpret mode):
+  * split-KV Pallas kernel vs XLA grouped-einsum parity — f32 and bf16, GQA
+    ratios 1/4/8, prefix lengths including non-block-multiples, per-request
+    lengths, S>1 (prefill-into-cache);
+  * paged kernel (block-table indexed pages) parity + pool scatter semantics;
+  * block allocator free-list reuse, OOM, and LRU eviction;
+  * generate() token-parity between decode_kernel="pallas" and "xla";
+  * generate_paged() mixed-length batches == per-request dense generate.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.pallas import decode_attention as da
+
+import jax.numpy as jnp
+
+
+def _naive(q, k, v, lengths):
+    """Loop-and-numpy reference (f32). k/v head-leading [B, Hkv, T, D]."""
+    B, S, Hq, D = q.shape
+    Hkv = k.shape[1]
+    G = Hq // Hkv
+    lengths = np.broadcast_to(np.asarray(lengths).reshape(-1), (B,))
+    out = np.zeros(q.shape, np.float32)
+    for b in range(B):
+        for s in range(S):
+            for h in range(Hq):
+                n = h // G
+                t = lengths[b] + s + 1          # causal horizon
+                sc = (q[b, s, h].astype(np.float32)
+                      @ k[b, n, :t].astype(np.float32).T) / np.sqrt(D)
+                p = np.exp(sc - sc.max())
+                p /= p.sum()
+                out[b, s, h] = p @ v[b, n, :t].astype(np.float32)
+    return out
+
+
+def _rand(shape, dtype, rng):
+    return jnp.asarray(rng.standard_normal(shape), dtype)
+
+
+@pytest.mark.parametrize("gqa", [1, 4, 8])
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_splitkv_parity(gqa, dtype):
+    rng = np.random.default_rng(0)
+    B, S, Hq, D, T = 2, 1, 8, 16, 64
+    Hkv = Hq // gqa
+    dt = jnp.dtype(dtype)
+    q = _rand((B, S, Hq, D), dt, rng)
+    k = _rand((B, Hkv, T, D), dt, rng)
+    v = _rand((B, Hkv, T, D), dt, rng)
+    length = 37                                  # not a block multiple
+    ref = _naive(np.asarray(q, np.float32), np.asarray(k, np.float32),
+                 np.asarray(v, np.float32), length)
+    tol = 1e-5 if dtype == "float32" else 3e-2
+    for kern in ("xla", "pallas"):
+        got = np.asarray(da.decode_attention(q, k, v, length, kernel=kern),
+                         np.float32)
+        np.testing.assert_allclose(got, ref, atol=tol, rtol=tol,
+                                   err_msg=f"{kern} gqa={gqa} {dtype}")
+
+
+def test_splitkv_per_request_lengths_and_prefill():
+    rng = np.random.default_rng(1)
+    B, Hq, Hkv, D, T = 2, 4, 2, 16, 96
+    q = _rand((B, 5, Hq, D), jnp.float32, rng)   # S>1: prefill-into-cache
+    k = _rand((B, Hkv, T, D), jnp.float32, rng)
+    v = _rand((B, Hkv, T, D), jnp.float32, rng)
+    lengths = np.array([11, 60])                 # mixed, non-block-multiple
+    ref = _naive(np.asarray(q), np.asarray(k), np.asarray(v), lengths)
+    for kern in ("xla", "pallas"):
+        got = np.asarray(da.decode_attention(q, k, v, jnp.asarray(lengths),
+                                             kernel=kern))
+        np.testing.assert_allclose(got, ref, atol=1e-5, rtol=1e-5,
+                                   err_msg=kern)
+
+
+def test_xla_path_has_no_repeated_kv():
+    """The grouped-einsum XLA path must not materialize rep-expanded K/V:
+    its jaxpr may not contain any array of the [B, T, Hq, D] shape."""
+    import jax
+
+    B, Hq, Hkv, D, T = 1, 8, 2, 16, 64
+    rng = np.random.default_rng(2)
+    q = _rand((B, 1, Hq, D), jnp.float32, rng)
+    k = _rand((B, Hkv, T, D), jnp.float32, rng)
+    v = _rand((B, Hkv, T, D), jnp.float32, rng)
+    jaxpr = jax.make_jaxpr(
+        lambda q, k, v: da.decode_attention_xla(q, k, v, 10))(q, k, v)
+    expanded = (B, Hq, T, D)
+    for eqn in jaxpr.jaxpr.eqns:
+        for var in eqn.outvars:
+            assert tuple(var.aval.shape) != expanded, eqn
+
+
+def test_paged_parity_and_update():
+    rng = np.random.default_rng(3)
+    B, S, Hq, Hkv, D, BS, P, NB = 2, 1, 8, 2, 16, 16, 12, 4
+    lengths = jnp.asarray([37, 20], jnp.int32)
+    tables = jnp.asarray([[3, 7, 1, 9], [5, 2, 0, 0]], jnp.int32)
+    k_pages = _rand((Hkv, P, BS, D), jnp.float32, rng)
+    v_pages = _rand((Hkv, P, BS, D), jnp.float32, rng)
+    q = _rand((B, S, Hq, D), jnp.float32, rng)
+    kd = np.asarray(k_pages)[:, np.asarray(tables)].reshape(
+        Hkv, B, NB * BS, D).swapaxes(0, 1)
+    vd = np.asarray(v_pages)[:, np.asarray(tables)].reshape(
+        Hkv, B, NB * BS, D).swapaxes(0, 1)
+    ref = _naive(np.asarray(q), kd, vd, np.asarray(lengths))
+    for kern in ("xla", "pallas"):
+        got = np.asarray(da.paged_decode_attention(q, k_pages, v_pages,
+                                                   tables, lengths,
+                                                   kernel=kern))
+        np.testing.assert_allclose(got, ref, atol=1e-5, rtol=1e-5,
+                                   err_msg=kern)
+
+    # scatter: valid rows land at (table[pos//BS], pos%BS); invalid dropped
+    k_new = _rand((B, 2, Hkv, D), jnp.float32, rng)
+    v_new = _rand((B, 2, Hkv, D), jnp.float32, rng)
+    valid = jnp.asarray([[True, True], [True, False]])
+    pos = da.write_positions(lengths, 2, valid=valid, capacity=NB * BS)
+    k2, _ = da.paged_cache_update(k_pages, v_pages, k_new, v_new, tables, pos)
+    k2 = np.asarray(k2)
+    np.testing.assert_allclose(k2[:, 1, 5], np.asarray(k_new)[0, 0])  # 37 -> p1s5
+    np.testing.assert_allclose(k2[:, 1, 6], np.asarray(k_new)[0, 1])
+    np.testing.assert_allclose(k2[:, 2, 4], np.asarray(k_new)[1, 0])  # 20 -> p2s4
+    changed = (np.abs(k2 - np.asarray(k_pages)).max(axis=(0, 2, 3)) > 0)
+    assert changed.sum() == 2                   # pages 1 and 2 only
+
+
+def test_no_x64_leak_into_pallas_calls():
+    """paddle_tpu runs with jax_enable_x64 on; any f64/i64 operand reaching a
+    pallas_call breaks Mosaic on the real chip (no f64 vector ops). Trace both
+    kernels with HOSTILE dtypes (f64 q, i64 lengths/tables) and assert the
+    wrappers normalized everything before the kernel boundary."""
+    import jax
+
+    def walk(jaxpr, out):
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name == "pallas_call":
+                out.append(eqn)
+            for val in eqn.params.values():
+                for v in (val if isinstance(val, (list, tuple)) else [val]):
+                    inner = getattr(v, "jaxpr", None)
+                    if inner is not None:
+                        walk(inner if hasattr(inner, "eqns") else inner.jaxpr,
+                             out)
+        return out
+
+    B, S, Hq, Hkv, D, T = 2, 1, 8, 2, 16, 64
+    q = jnp.zeros((B, S, Hq, D), jnp.float64)
+    k = jnp.zeros((B, Hkv, T, D), jnp.float32)
+    ln = jnp.zeros((B,), jnp.int64)
+    tables = jnp.zeros((B, 4), jnp.int64)
+    kp = jnp.zeros((Hkv, 8, 16, D), jnp.float32)
+    for jx in (
+        jax.make_jaxpr(lambda q, k, ln: da.decode_attention(q, k, k, ln))(
+            q, k, ln),
+        jax.make_jaxpr(lambda q, kp, t, ln: da.paged_decode_attention(
+            q, kp, kp, t, ln))(q, kp, tables, ln),
+    ):
+        eqns = walk(jx.jaxpr, [])
+        assert eqns, "pallas_call not found in trace"
+        bad = [str(v.aval) for e in eqns for v in e.invars
+               if getattr(v.aval, "dtype", None) in (jnp.float64, jnp.int64)]
+        assert not bad, bad
+
+
+# ------------------------------------------------------------- allocator/pool
+def test_block_allocator_reuse_and_oom():
+    from paddle_tpu.inference.kv_cache import BlockAllocator, CacheOutOfBlocks
+
+    a = BlockAllocator(4)
+    first = a.allocate(2)
+    assert a.available == 2 and a.in_use == 2
+    a.free(first)
+    with pytest.raises(ValueError):
+        a.free(first)                           # double free
+    again = a.allocate(2)
+    assert set(again) == set(first)             # free-list reuse
+    a.allocate(2)
+    with pytest.raises(CacheOutOfBlocks):
+        a.allocate(1)
+
+
+def test_paged_cache_reserve_release_evict():
+    from paddle_tpu.inference.kv_cache import CacheOutOfBlocks, PagedKVCache
+
+    c = PagedKVCache(num_layers=1, num_kv_heads=2, head_dim=8, block_size=4,
+                     num_blocks=8, dtype="float32")
+    t1 = c.reserve("r1", 10)                    # 3 blocks
+    t2 = c.reserve("r2", 16)                    # 4 blocks
+    assert len(t1) == 3 and len(t2) == 4 and c.blocks_in_use == 7
+    assert len(c.block_table("r1", pad_to=5)) == 5
+    with pytest.raises(CacheOutOfBlocks):
+        c.reserve("r3", 8)                      # needs 2, only 1 free, no one done
+    c.mark_done("r1")
+    c.reserve("r3", 8)                          # evicts r1 (LRU done)
+    assert c.blocks_in_use == 6
+    with pytest.raises(KeyError):
+        c.block_table("r1")                     # evicted
+    c.release("r2")
+    c.release("r3")
+    assert c.blocks_in_use == 0 and c.utilization == 0.0
+    with pytest.raises(KeyError):
+        c.set_length("nope", 1)
+
+
+def test_paged_cache_length_capacity_guard():
+    from paddle_tpu.inference.kv_cache import PagedKVCache
+
+    c = PagedKVCache(1, 2, 8, block_size=4, num_blocks=4, dtype="float32")
+    c.reserve("r", 6)                           # 2 blocks = capacity 8
+    c.set_length("r", 8)
+    with pytest.raises(ValueError):
+        c.set_length("r", 9)
+
+
+# ------------------------------------------------------- generate() parity
+def _gpt(**over):
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    cfg = dict(vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+               num_kv_heads=2, max_position=64, dropout=0.0)
+    cfg.update(over)
+    with paddle.utils.unique_name.guard():
+        paddle.seed(7)
+        m = GPTForCausalLM(GPTConfig(**cfg))
+    m.eval()
+    return m
+
+
+def _greedy_reference(model, ids, n):
+    import jax.numpy as jnp
+
+    ids = np.asarray(ids)
+    for _ in range(n):
+        logits = model(paddle.to_tensor(ids))
+        nxt = np.asarray(jnp.argmax(logits._value[:, -1], axis=-1))
+        ids = np.concatenate([ids, nxt[:, None].astype(ids.dtype)], axis=1)
+    return ids
+
+
+def test_generate_token_parity_pallas_vs_xla():
+    m = _gpt()
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, 128, (2, 5)).astype("int64")
+    want = _greedy_reference(m, prompt, 6)
+    for kern in ("xla", "pallas"):
+        got = np.asarray(m.generate(paddle.to_tensor(prompt),
+                                    max_new_tokens=6, dtype=None,
+                                    decode_kernel=kern)._value)
+        np.testing.assert_array_equal(got, want, err_msg=kern)
+
+
+def test_llama_generate_token_parity():
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+    with paddle.utils.unique_name.guard():
+        paddle.seed(7)
+        m = LlamaForCausalLM(llama_tiny())
+    m.eval()
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, 512, (2, 5)).astype("int64")
+    want = _greedy_reference(m, prompt, 6)
+    for kern in ("xla", "pallas"):
+        got = np.asarray(m.generate(paddle.to_tensor(prompt),
+                                    max_new_tokens=6, dtype=None,
+                                    decode_kernel=kern)._value)
+        np.testing.assert_array_equal(got, want, err_msg=kern)
+
+
+def test_generate_paged_mixed_lengths_match_dense():
+    from paddle_tpu.inference.kv_cache import PagedKVCache
+
+    m = _gpt()
+    rng = np.random.default_rng(0)
+    NEW = 5
+    prompts = [rng.integers(0, 128, n).astype("int64") for n in (5, 9, 3)]
+    refs = [np.asarray(m.generate(paddle.to_tensor(p[None]),
+                                  max_new_tokens=NEW, dtype=None,
+                                  decode_kernel="xla")._value)[0]
+            for p in prompts]
+    cache = PagedKVCache(2, 2, 16, block_size=8, num_blocks=24,
+                         dtype="float32")
+    plens = np.asarray([len(p) for p in prompts])
+    P = int(plens.max())
+    batch = np.zeros((len(prompts), P), np.int64)
+    for i, p in enumerate(prompts):
+        batch[i, :len(p)] = p
+    nb = max(cache.blocks_for(int(p) + NEW) for p in plens)
+    for i in range(len(prompts)):
+        cache.reserve(i, int(plens[i]) + NEW)
+    tbl = np.stack([cache.block_table(i, pad_to=nb)
+                    for i in range(len(prompts))])
+    for kern in ("xla", "pallas"):
+        toks = np.asarray(m.generate_paged(batch, plens, cache, tbl,
+                                           max_new_tokens=NEW,
+                                           decode_kernel=kern)._value)
+        for i, (p, ref) in enumerate(zip(prompts, refs)):
+            np.testing.assert_array_equal(toks[i], ref[len(p):],
+                                          err_msg=f"{kern} req {i}")
+
+
+def test_generate_paged_learned_positions():
+    """GPT-2-style config (no rope): the paged path gathers POSITION
+    embeddings per request ([B, S] clipped ids), a distinct codepath from
+    rope's absolute-frequency rotation."""
+    from paddle_tpu.inference.kv_cache import PagedKVCache
+
+    m = _gpt(use_rope=False, use_rms_norm=False, use_swiglu=False,
+             num_kv_heads=4)
+    rng = np.random.default_rng(1)
+    NEW = 2
+    prompts = [rng.integers(0, 128, n).astype("int64") for n in (3, 5)]
+    refs = [np.asarray(m.generate(paddle.to_tensor(p[None]),
+                                  max_new_tokens=NEW, dtype=None,
+                                  decode_kernel="xla")._value)[0]
+            for p in prompts]
+    cache = PagedKVCache(2, 4, 16, block_size=8, num_blocks=8,
+                         dtype="float32")
+    plens = np.asarray([3, 5])
+    batch = np.zeros((2, 5), np.int64)
+    for i, p in enumerate(prompts):
+        batch[i, :len(p)] = p
+    for i in range(2):
+        cache.reserve(i, int(plens[i]) + NEW)
+    tbl = np.stack([cache.block_table(i, pad_to=1) for i in range(2)])
+    toks = np.asarray(m.generate_paged(batch, plens, cache, tbl,
+                                       max_new_tokens=NEW,
+                                       decode_kernel="pallas")._value)
+    for i, (p, ref) in enumerate(zip(prompts, refs)):
+        np.testing.assert_array_equal(toks[i], ref[len(p):], err_msg=str(i))
+
+
+def test_generate_batching_predictor_serves_mixed_lengths():
+    import threading
+
+    from paddle_tpu.inference.serving import GenerateBatchingPredictor
+
+    m = _gpt()
+    rng = np.random.default_rng(0)
+    NEW = 4
+    prompts = [rng.integers(0, 128, n).astype("int64") for n in (4, 7)]
+    refs = [np.asarray(m.generate(paddle.to_tensor(p[None]),
+                                  max_new_tokens=NEW, dtype=None,
+                                  decode_kernel="xla")._value)[0]
+            for p in prompts]
+    gp = GenerateBatchingPredictor(m, max_batch_size=4, max_delay_ms=30,
+                                   max_new_tokens=NEW, decode_kernel="pallas",
+                                   block_size=8, num_blocks=16)
+    try:
+        results = {}
+
+        def call(i, p):
+            results[i] = gp.infer(p, timeout=300)
+
+        threads = [threading.Thread(target=call, args=(i, p))
+                   for i, p in enumerate(prompts)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i, ref in enumerate(refs):
+            np.testing.assert_array_equal(results[i], ref, err_msg=f"req {i}")
+        assert gp.kv_cache.blocks_in_use == 0    # pool drained after serving
+    finally:
+        gp.close()

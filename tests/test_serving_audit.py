@@ -1,0 +1,72 @@
+"""Serving audit regression guard (ISSUE-1 satellite: CI/tooling).
+
+The round-5 serving regression class (per-call tunneled cache allocation;
+first-burst warm-up) is pinned by bench.py's scan-vs-e2e audit: the serving
+section must emit `bN_tokens_per_sec` / `bN_scan_tokens_per_sec` AND the
+derived gap fields, with the gap computed correctly. If someone rewires the
+serving bench and drops the audit, these tests fail before the next bench run
+silently loses the guard.
+"""
+import importlib
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+bench = importlib.import_module("bench")
+
+
+def test_audit_fields_computed():
+    out = {
+        "b1_tokens_per_sec": 600.0, "b1_scan_tokens_per_sec": 625.0,
+        "b8_tokens_per_sec": 3500.0, "b8_scan_tokens_per_sec": 3600.0,
+    }
+    bench.serving_audit_fields(out)
+    assert out["b1_audit_gap_pct"] == pytest.approx(4.0)
+    assert out["b1_audit"] == "ok"
+    assert out["b8_audit_gap_pct"] == pytest.approx(100 * (100 / 3600), abs=0.01)
+    assert out["b8_audit"] == "ok"
+
+
+def test_audit_flags_regression_over_threshold():
+    out = {"b1_tokens_per_sec": 300.0, "b1_scan_tokens_per_sec": 600.0}
+    bench.serving_audit_fields(out)
+    assert out["b1_audit_gap_pct"] == pytest.approx(50.0)
+    assert out["b1_audit"] == "e2e-overhead"       # the r4 regression signature
+
+
+def test_audit_faster_e2e_clamps_to_zero():
+    # measurement noise can put e2e ABOVE scan; the gap clamps at 0, never
+    # negative (a negative "gap" would hide a later real regression in deltas)
+    out = {"b1_tokens_per_sec": 650.0, "b1_scan_tokens_per_sec": 600.0}
+    bench.serving_audit_fields(out)
+    assert out["b1_audit_gap_pct"] == 0.0
+    assert out["b1_audit"] == "ok"
+
+
+def test_audit_skips_missing_sections():
+    out = {"b1_tokens_per_sec": 600.0}              # scan rate absent
+    bench.serving_audit_fields(out)
+    assert "b1_audit_gap_pct" not in out
+    assert "b8_audit_gap_pct" not in out
+
+
+def test_serving_bench_emits_audit_fields():
+    """The serving section's field wiring itself: bench_serving must route its
+    measurements through serving_audit_fields (source-level pin — running the
+    full serving bench on CPU takes minutes)."""
+    import inspect
+
+    src = inspect.getsource(bench.bench_serving)
+    assert "serving_audit_fields(" in src
+    assert "scan_tokens_per_sec" in src
+
+
+def test_decode_attention_bench_reports_vs_baseline():
+    """The decode_attention sub-bench must report the Pallas-vs-XLA ratio
+    under the contract key `vs_baseline` for every shape entry."""
+    import inspect
+
+    src = inspect.getsource(bench.bench_decode_attention)
+    assert "vs_baseline" in src and "pallas_us_per_step" in src

@@ -76,6 +76,10 @@ def test_param_counts_sane():
     (lambda: models.densenet121(num_classes=10), (2, 3, 64, 64)),
 ])
 def test_train_step(ctor, in_shape):
+    # deterministic init: under the full suite the global RNG state depends
+    # on every previously-run test, and an unlucky init makes 4 SGD steps
+    # not enough to move the loss down (order-dependent flake)
+    paddle.seed(0)
     model = ctor()
     model.train()
     opt = paddle.optimizer.SGD(parameters=model.parameters(),
