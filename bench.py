@@ -240,6 +240,89 @@ def serving_audit_fields(out):
     return out
 
 
+def bench_serving_pressure(on_accel, dev):
+    """Serving under pressure: more concurrent /generate clients than the
+    paged KV pool can hold at once, plus a sprinkle of tight deadlines —
+    reports the terminal-outcome counters (completed/shed/deferred/timeout)
+    and the latency tail the resilience layer is supposed to bound. The
+    conservation field is the headline: every accepted request must land in
+    exactly one terminal bucket or the runtime is leaking work."""
+    import threading as _threading
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.resilience import Rejected
+    from paddle_tpu.inference.serving import GenerateBatchingPredictor
+    from paddle_tpu.models.gpt import GPTForCausalLM
+
+    paddle.seed(0)
+    if on_accel:
+        cfg, P, NEW, clients = _gpt350m_cfg(), 64, 32, 32
+        blocks, bs, tight_s = 48, 32, 2.0
+    else:
+        cfg, P, NEW, clients = _gpt_smoke_cfg(max_position=64), 8, 8, 8
+        blocks, bs, tight_s = 6, 8, 0.75
+    # pool deliberately holds ~half the concurrent demand so the deferral /
+    # shed machinery actually runs (blocks_for(P+NEW) per request)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    gp = GenerateBatchingPredictor(model, max_batch_size=4, max_delay_ms=5,
+                                   max_new_tokens=NEW, block_size=bs,
+                                   num_blocks=blocks, max_defers=64)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (clients, P)).astype(np.int64)
+    gp.infer(ids[0], timeout=600)          # warm the B=1 compiled shape
+    client_out = {"ok": 0, "timeout": 0, "shed": 0, "fail": 0}
+    lock = _threading.Lock()
+
+    def client(i):
+        # every 4th client runs a tight deadline to exercise the timeout leg
+        t = tight_s if i % 4 == 0 else 600
+        try:
+            gp.infer(ids[i], timeout=t)
+            k = "ok"
+        except TimeoutError:
+            k = "timeout"
+        except Rejected:
+            k = "shed"
+        except Exception:
+            k = "fail"
+        with lock:
+            client_out[k] += 1
+
+    threads = [_threading.Thread(target=client, args=(i,))
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    out = gp.metrics.snapshot()
+    gp.close()
+    out.update(clients=clients, prompt=P, new_tokens=NEW,
+               pool_blocks=blocks, block_size=bs,
+               client_ok=client_out["ok"], client_timeout=client_out["timeout"],
+               client_shed=client_out["shed"], client_fail=client_out["fail"])
+    serving_pressure_fields(out)
+    return out, None
+
+
+def serving_pressure_fields(out):
+    """Conservation + latency-tail fields for the serving-pressure section:
+    every ACCEPTED request must land in exactly one terminal bucket
+    (completed|failed|timeouts) — a mismatch means the runtime leaked or
+    double-counted work. Pure function of the measured dict so tests can pin
+    the wiring on synthetic inputs."""
+    acc = out.get("accepted")
+    if acc is not None:
+        terminal = (out.get("completed", 0) + out.get("failed", 0)
+                    + out.get("timeouts", 0))
+        out["terminal_total"] = terminal
+        out["conservation"] = "ok" if terminal == acc else "leak"
+    p50, p99 = out.get("p50_ms"), out.get("p99_ms")
+    if p50 and p99:
+        out["tail_ratio_p99_p50"] = round(p99 / p50, 2)
+    return out
+
+
 def bench_decode_attention(on_accel, dev):
     """Isolated decode-attention kernel bench: split-KV Pallas vs the XLA
     grouped-einsum path over a dense cache (q = 1 token). Steps are chained
@@ -457,6 +540,15 @@ def main():
     except Exception:
         pass
     try:
+        pressure, pressure_err = bench_serving_pressure(on_accel, dev)
+    except Exception as e:
+        pressure, pressure_err = None, {"error": repr(e)[:200]}
+    gc.collect()
+    try:
+        jax.clear_caches()
+    except Exception:
+        pass
+    try:
         decode_attn, decode_attn_err = bench_decode_attention(on_accel, dev)
     except Exception as e:
         decode_attn, decode_attn_err = None, {"error": repr(e)[:200]}
@@ -490,6 +582,8 @@ def main():
             "audit": gpt["audit"],
             "gpt": gpt,
             "serving": serving if serving is not None else serving_err,
+            "serving_pressure": (pressure if pressure is not None
+                                 else pressure_err),
             "decode_attention": (decode_attn if decode_attn is not None
                                  else decode_attn_err),
             "long_context": long_ctx if long_ctx is not None else long_ctx_err,

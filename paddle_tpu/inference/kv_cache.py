@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import itertools
 import math
+import threading
 
 import numpy as np
 
@@ -35,10 +36,11 @@ class BlockAllocator:
     serving loop keeps touching the same hot pages instead of sweeping the
     whole pool."""
 
-    def __init__(self, num_blocks: int):
+    def __init__(self, num_blocks: int, faults=None):
         self.num_blocks = int(num_blocks)
         self._free = list(range(self.num_blocks - 1, -1, -1))
         self._live: set[int] = set()
+        self._faults = faults  # inference.faults.FaultInjector | None
 
     @property
     def available(self) -> int:
@@ -49,6 +51,8 @@ class BlockAllocator:
         return self.num_blocks - len(self._free)
 
     def allocate(self, n: int) -> list[int]:
+        if self._faults is not None:
+            self._faults.check("kv.allocate")   # may raise CacheOutOfBlocks
         if n > len(self._free):
             raise CacheOutOfBlocks(
                 f"need {n} blocks, {len(self._free)} free of {self.num_blocks}")
@@ -88,7 +92,7 @@ class PagedKVCache:
     """
 
     def __init__(self, num_layers, num_kv_heads, head_dim, block_size=128,
-                 num_blocks=64, dtype="bfloat16"):
+                 num_blocks=64, dtype="bfloat16", faults=None):
         import jax.numpy as jnp
 
         self.num_layers = int(num_layers)
@@ -105,9 +109,14 @@ class PagedKVCache:
                         for _ in range(self.num_layers)]
         self.v_pages = [jnp.zeros(shape, self.dtype)
                         for _ in range(self.num_layers)]
-        self.allocator = BlockAllocator(self.num_blocks)
+        self.allocator = BlockAllocator(self.num_blocks, faults=faults)
         self._requests: dict = {}
         self._clock = itertools.count()
+        self._faults = faults
+        # host bookkeeping is hit from HTTP handler threads (admission
+        # checks), the batcher thread (reserve/release), and clients
+        # (gather); RLock because reserve -> _evict_lru -> release re-enters
+        self._lock = threading.RLock()
 
     # ------------------------------------------------------------- identity
     def signature(self):
@@ -123,64 +132,105 @@ class PagedKVCache:
         """Allocate blocks covering max_seq_len for a new request; returns the
         block table as int32 [num_blocks_for(max_seq_len)]. When the free list
         runs dry and `evict`, finished-but-retained requests are evicted
-        least-recently-used first."""
-        if request_id in self._requests:
-            raise ValueError(f"request {request_id!r} already reserved")
-        n = self.blocks_for(max_seq_len)
-        if evict and self.allocator.available < n:
-            self._evict_lru(n - self.allocator.available)
-        blocks = self.allocator.allocate(n)  # raises CacheOutOfBlocks
-        self._requests[request_id] = _Request(blocks, 0, next(self._clock))
-        return np.asarray(blocks, np.int32)
+        least-recently-used first.
+
+        Atomic: either the request ends up fully reserved, or the cache is
+        byte-identical to before the call — in particular, nothing is evicted
+        when eviction still could not cover the allocation (the old
+        evict-then-fail path destroyed retained caches for nothing)."""
+        with self._lock:
+            if self._faults is not None:
+                self._faults.check("kv.reserve")  # injected pool-dry faults
+            if request_id in self._requests:
+                raise ValueError(f"request {request_id!r} already reserved")
+            n = self.blocks_for(max_seq_len)
+            if self.allocator.available < n:
+                shortfall = n - self.allocator.available
+                if not evict or self.evictable_blocks < shortfall:
+                    raise CacheOutOfBlocks(
+                        f"need {n} blocks, {self.allocator.available} free + "
+                        f"{self.evictable_blocks if evict else 0} evictable "
+                        f"of {self.num_blocks}")
+                self._evict_lru(shortfall)
+            blocks = self.allocator.allocate(n)  # raises CacheOutOfBlocks
+            self._requests[request_id] = _Request(blocks, 0,
+                                                  next(self._clock))
+            return np.asarray(blocks, np.int32)
 
     def _evict_lru(self, need: int):
-        done = sorted((r for r in self._requests.items() if r[1].done),
-                      key=lambda kv: kv[1].touch)
-        freed = 0
-        for rid, req in done:
-            if freed >= need:
-                break
-            freed += len(req.blocks)
-            self.release(rid)
+        with self._lock:
+            done = sorted((r for r in self._requests.items() if r[1].done),
+                          key=lambda kv: kv[1].touch)
+            freed = 0
+            for rid, req in done:
+                if freed >= need:
+                    break
+                freed += len(req.blocks)
+                self.release(rid)
 
     def mark_done(self, request_id):
         """Request finished decoding; its pages stay readable (gather) but
         become evictable when the pool needs room."""
-        self._requests[request_id].done = True
+        with self._lock:
+            self._requests[request_id].done = True
 
     def release(self, request_id):
-        req = self._requests.pop(request_id)
-        self.allocator.free(req.blocks)
+        with self._lock:
+            req = self._requests.pop(request_id)
+            self.allocator.free(req.blocks)
 
     # ------------------------------------------------------------- metadata
     def block_table(self, request_id, pad_to=None):
         """int32 table of page ids; padded with page 0 (fetched-but-masked —
         the kernel requires valid page ids in dead slots)."""
-        req = self._requests[request_id]
-        req.touch = next(self._clock)
-        tbl = list(req.blocks)
+        with self._lock:
+            req = self._requests[request_id]
+            req.touch = next(self._clock)
+            tbl = list(req.blocks)
         if pad_to is not None:
             tbl += [0] * (int(pad_to) - len(tbl))
         return np.asarray(tbl, np.int32)
 
     def length(self, request_id) -> int:
-        return self._requests[request_id].length
+        with self._lock:
+            return self._requests[request_id].length
 
     def set_length(self, request_id, n: int):
-        req = self._requests[request_id]
-        if n > len(req.blocks) * self.block_size:
-            raise ValueError(
-                f"length {n} exceeds reserved capacity "
-                f"{len(req.blocks) * self.block_size}")
-        req.length = int(n)
+        with self._lock:
+            req = self._requests[request_id]
+            if n > len(req.blocks) * self.block_size:
+                raise ValueError(
+                    f"length {n} exceeds reserved capacity "
+                    f"{len(req.blocks) * self.block_size}")
+            req.length = int(n)
 
     @property
     def blocks_in_use(self) -> int:
         return self.allocator.in_use
 
     @property
+    def free_blocks(self) -> int:
+        return self.allocator.available
+
+    @property
+    def evictable_blocks(self) -> int:
+        """Blocks held by finished-but-retained requests (reclaimable)."""
+        with self._lock:
+            return sum(len(r.blocks) for r in self._requests.values()
+                       if r.done)
+
+    @property
     def utilization(self) -> float:
         return self.allocator.in_use / self.num_blocks
+
+    @property
+    def live_utilization(self) -> float:
+        """Fraction of the pool held by still-DECODING requests — the
+        admission-control pressure signal (done-but-retained blocks are
+        reclaimable on demand, so they don't count as pressure)."""
+        with self._lock:
+            return (self.allocator.in_use - self.evictable_blocks) \
+                / self.num_blocks
 
     # ------------------------------------------------------------ device I/O
     def commit(self, k_pages, v_pages):
@@ -193,9 +243,10 @@ class PagedKVCache:
     def gather(self, request_id, layer: int):
         """Host-side contiguous [length, Hkv, D] (k, v) view of a request's
         cache — debug/audit path; the kernel never gathers."""
-        req = self._requests[request_id]
-        n = self.blocks_for(max(req.length, 1))
-        tbl = np.asarray(req.blocks[:n])
+        with self._lock:
+            req = self._requests[request_id]
+            n = self.blocks_for(max(req.length, 1))
+            tbl = np.asarray(req.blocks[:n])
 
         def _dense(pages):
             # [Hkv, n, BS, D] -> [n*BS, Hkv, D]

@@ -7,25 +7,99 @@ concurrent requests into a single device launch (decode/serving throughput on
 TPU is batch-bound — see docs/PERF.md serving numbers), then splits results.
 The HTTP front end is a stdlib ThreadingHTTPServer speaking npz, so a client
 needs nothing but numpy.
+
+Fault tolerance (inference/resilience.py): every request carries ONE deadline
+from HTTP header → queue → decode launch and reaches exactly ONE terminal
+outcome (result | timeout | shed) through a compare-and-swap on the request
+state — a client timing out while the batcher is mid-launch can never race
+into both a TimeoutError and a delivered result. Overload is rejected at the
+door (429/503 + Retry-After) instead of exploding mid-batch; a dead batcher
+thread is restarted by the clients waiting on it; repeated predictor failures
+trip a circuit breaker; a KV-pool/model signature mismatch degrades to the
+dense generate path instead of crashing. inference/faults.py injects
+deterministic faults at the seams for the chaos tests.
 """
 from __future__ import annotations
 
 import io
+import math
 import queue
 import threading
 import time
 
 import numpy as np
 
+from .faults import ThreadDeath
+from .kv_cache import CacheOutOfBlocks
+from .resilience import (
+    AdmissionController,
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceeded,
+    Rejected,
+    ServerBusy,
+    ServiceUnavailable,
+    ServingMetrics,
+    Supervisor,
+)
+
 __all__ = ["BatchingPredictor", "GenerateBatchingPredictor", "InferenceServer"]
+
+_PENDING, _DONE, _CANCELLED = "pending", "done", "cancelled"
 
 
 class _Request:
-    def __init__(self, arrays):
+    """One in-flight request with compare-and-swap terminal semantics.
+
+    Exactly one of finish()/fail()/cancel() wins; the losers observe False
+    and must not deliver their outcome. This is what makes "timed out in the
+    queue", "computed but the client already gave up", and "failed mid-batch"
+    mutually exclusive instead of racy."""
+
+    __slots__ = ("arrays", "event", "result", "error", "deadline", "retries",
+                 "defers", "t0", "_lock", "_state")
+
+    def __init__(self, arrays, deadline=None):
         self.arrays = arrays
+        self.deadline = deadline
         self.event = threading.Event()
         self.result = None
         self.error = None
+        self.retries = 0        # failed-batch re-runs consumed
+        self.defers = 0         # pool-full next-batch deferrals consumed
+        self.t0 = None
+        self._lock = threading.Lock()
+        self._state = _PENDING
+
+    @property
+    def state(self):
+        return self._state
+
+    def finish(self, result) -> bool:
+        with self._lock:
+            if self._state != _PENDING:
+                return False
+            self.result = result
+            self._state = _DONE
+            self.event.set()
+            return True
+
+    def fail(self, error) -> bool:
+        with self._lock:
+            if self._state != _PENDING:
+                return False
+            self.error = error
+            self._state = _DONE
+            self.event.set()
+            return True
+
+    def cancel(self) -> bool:
+        with self._lock:
+            if self._state != _PENDING:
+                return False
+            self._state = _CANCELLED
+            self.event.set()
+            return True
 
 
 class BatchingPredictor:
@@ -33,29 +107,175 @@ class BatchingPredictor:
 
     Requests are padded to the next bucket size (powers of two up to
     `max_batch_size`) so the number of compiled programs stays bounded —
-    dynamic shapes would recompile per batch size otherwise."""
+    dynamic shapes would recompile per batch size otherwise.
 
-    def __init__(self, predictor, max_batch_size=8, max_delay_ms=2.0):
+    Resilience knobs: `admission` sheds load at submit time (ServerBusy →
+    429), `breaker` fails fast after repeated predictor faults
+    (ServiceUnavailable → 503), `max_retries` re-runs requests from a failed
+    batch before surfacing the error, and a Supervisor restarts the batcher
+    thread if it dies (clients waiting in `_await` drive the restart, so a
+    dead batcher with a full queue heals without a watchdog thread)."""
+
+    def __init__(self, predictor, max_batch_size=8, max_delay_ms=2.0,
+                 faults=None, admission=None, breaker=None, max_retries=1,
+                 max_restarts=5):
         self.predictor = predictor
         self.max_batch_size = int(max_batch_size)
         self.max_delay = max_delay_ms / 1000.0
+        self.max_retries = int(max_retries)
+        self._faults = faults
+        self._clock = faults.monotonic if faults is not None else time.monotonic
+        self.metrics = ServingMetrics()
+        self.admission = admission if admission is not None \
+            else AdmissionController()
+        self.breaker = breaker if breaker is not None else CircuitBreaker(
+            failure_threshold=5, reset_after=1.0, clock=self._clock)
         self._queue: queue.Queue = queue.Queue()
         self._stop = threading.Event()
+        self._draining = threading.Event()
+        self._busy = False
         self.batch_sizes: list[int] = []  # observability: actual batch fill
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="batching-predictor")
-        self._thread.start()
+        self._sup = Supervisor(self._make_thread, name=type(self).__name__,
+                               max_restarts=max_restarts)
+        self._sup.start()
+
+    def _make_thread(self):
+        return threading.Thread(target=self._thread_main, daemon=True,
+                                name="batching-predictor")
+
+    def _thread_main(self):
+        try:
+            self._loop()
+        except ThreadDeath:
+            pass    # worker dies (supervisor will heal) without excepthook noise
 
     # ---------------------------------------------------------------- client
-    def infer(self, *arrays, timeout=None):
-        """One logical sample in (arrays WITHOUT the batch dim), one out."""
-        req = _Request([np.asarray(a) for a in arrays])
+    def infer(self, *arrays, timeout=None, deadline=None):
+        """One logical sample in (arrays WITHOUT the batch dim), one out.
+
+        `timeout` seconds become a Deadline that rides with the request
+        through the queue and into the batch (`deadline` passes one in
+        directly); expiry anywhere raises DeadlineExceeded (a TimeoutError)
+        here, exactly once, with the queue slot reclaimed."""
+        req = self._make_request([np.asarray(a) for a in arrays],
+                                 timeout, deadline)
+        return self._submit(req)
+
+    def _make_request(self, arrays, timeout, deadline):
+        if deadline is None and timeout is not None:
+            deadline = Deadline.after(float(timeout), self._clock)
+        return _Request(arrays, deadline)
+
+    def _admission_check(self, arrays):
+        self.admission.admit(self._queue.qsize())
+
+    def _submit(self, req):
+        try:
+            if self._stop.is_set() or self._draining.is_set():
+                raise ServiceUnavailable("predictor is shutting down",
+                                         retry_after=None)
+            if self._sup.heal():
+                self.metrics.inc("batcher_restarts")
+            if not self.breaker.allow():
+                raise ServiceUnavailable(
+                    "circuit open after repeated predictor failures",
+                    retry_after=self.breaker.retry_after())
+            self._admission_check(req.arrays)
+        except Rejected as e:
+            self.metrics.inc("rejected_busy" if isinstance(e, ServerBusy)
+                             else "rejected_unavailable")
+            raise
+        except ValueError:   # malformed/oversized: no retry can fix it
+            self.metrics.inc("rejected_invalid")
+            raise
+        self.metrics.inc("accepted")
+        req.t0 = self._clock()
         self._queue.put(req)
-        if not req.event.wait(timeout):
-            raise TimeoutError("inference request timed out")
+        return self._await(req)
+
+    def _await(self, req):
+        """Wait for the terminal outcome, healing a dead batcher meanwhile."""
+        while True:
+            if req.deadline is None:
+                step = 0.1
+            else:
+                rem = req.deadline.remaining()
+                if rem <= 0:
+                    if req.cancel():
+                        self.metrics.inc("timeouts")
+                        self._observe(req)
+                        raise DeadlineExceeded("inference request timed out")
+                    break   # lost the race: a terminal outcome just landed
+                step = min(0.1, rem)
+            if req.event.wait(step):
+                break
+            try:
+                if self._sup.heal():
+                    self.metrics.inc("batcher_restarts")
+            except ServiceUnavailable as e:
+                self._fail(req, e)
+                raise
         if req.error is not None:
             raise req.error
         return req.result
+
+    # --------------------------------------------------------- terminal CAS
+    def _observe(self, req):
+        if req.t0 is not None:
+            self.metrics.observe_latency(self._clock() - req.t0)
+
+    def _finish_req(self, req, result) -> bool:
+        if req.finish(result):
+            self.metrics.inc("completed")
+            self._observe(req)
+            return True
+        # computed a result nobody will read (client cancelled mid-batch)
+        self.metrics.inc("wasted_results")
+        return False
+
+    def _fail(self, req, error) -> bool:
+        if not req.fail(error):
+            return False
+        if isinstance(error, DeadlineExceeded):
+            self.metrics.inc("timeouts")
+        else:
+            self.metrics.inc("failed")
+            if isinstance(error, ServerBusy):
+                self.metrics.inc("shed_busy")
+            elif isinstance(error, ServiceUnavailable):
+                self.metrics.inc("shed_unavailable")
+        self._observe(req)
+        return True
+
+    def _fail_or_retry(self, req, error):
+        """Failure isolation: give the request another batch before failing
+        it, unless the error is terminal by construction (shed/deadline) or
+        the request can no longer make its deadline."""
+        retryable = not isinstance(error, (Rejected, DeadlineExceeded))
+        if (retryable and req.retries < self.max_retries
+                and not self._stop.is_set()
+                and not (req.deadline is not None
+                         and req.deadline.expired())):
+            req.retries += 1
+            self.metrics.inc("retries")
+            self._queue.put(req)
+        else:
+            self._fail(req, error)
+
+    def _usable(self, req) -> bool:
+        """Collection-time filter: cancelled requests are skipped (their
+        client already took the timeout), expired ones are failed here —
+        either way they never cost a batch slot or a predictor call."""
+        state = req.state
+        if state != _PENDING:    # cancelled, or already terminal (requeued
+            if state == _CANCELLED:  # by a dying thread after finishing)
+                self.metrics.inc("cancelled_skipped")
+            return False
+        if req.deadline is not None and req.deadline.expired():
+            if self._fail(req, DeadlineExceeded("deadline expired in queue")):
+                self.metrics.inc("expired_in_queue")
+            return False
+        return True
 
     # ---------------------------------------------------------------- worker
     def _bucket(self, n):
@@ -66,29 +286,49 @@ class BatchingPredictor:
 
     def _loop(self):
         while not self._stop.is_set():
+            if self._faults is not None:
+                self._faults.check("batcher.tick")  # ThreadDeath escapes
             try:
                 first = self._queue.get(timeout=0.1)
             except queue.Empty:
                 continue
-            self._run_batch(self._collect(first))
+            self._busy = True
+            try:
+                batch = self._collect(first)
+                try:
+                    self._run_batch(batch)
+                except ThreadDeath:
+                    for r in batch:     # the dying thread strands no work
+                        if r.state == _PENDING:
+                            self._queue.put(r)
+                    raise
+            finally:
+                self._busy = False
 
     def _collect(self, first):
         """Collect up to max_batch_size requests within the max_delay window —
         waking EARLY once the bucket fills (a full batch arriving instantly
         used to still pay the whole window; VERDICT r5 weak #5)."""
-        batch = [first]
+        batch = [first] if self._usable(first) else []
         deadline = time.monotonic() + self.max_delay
         while len(batch) < self.max_batch_size:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 break
             try:
-                batch.append(self._queue.get(timeout=remaining))
+                r = self._queue.get(timeout=remaining)
             except queue.Empty:
                 break
+            if self._usable(r):
+                batch.append(r)
         return batch
 
     def _run_batch(self, batch):
+        if self._faults is not None:
+            self._faults.check("batcher.batch")  # ThreadDeath escapes
+        batch = [r for r in batch if self._usable(r)]
+        if not batch:
+            return
         try:
             n = len(batch)
             bucket = self._bucket(n)
@@ -100,18 +340,39 @@ class BatchingPredictor:
                     pad = np.repeat(arr[:1], bucket - n, axis=0)
                     arr = np.concatenate([arr, pad], axis=0)
                 stacked.append(arr)
+            if self._faults is not None:
+                self._faults.check("predictor.run")
             outs = self.predictor.run(stacked)
+            self.breaker.record_success()
             for j, r in enumerate(batch):
-                r.result = [o[j] for o in outs]
-                r.event.set()
-        except Exception as e:  # pragma: no cover - propagated to callers
+                self._finish_req(r, [o[j] for o in outs])
+        except Exception as e:
+            self.breaker.record_failure()
+            self.metrics.inc("batch_failures")
             for r in batch:
-                r.error = e
-                r.event.set()
+                self._fail_or_retry(r, e)
+
+    # ------------------------------------------------------------- lifecycle
+    def pending(self) -> int:
+        """Queued + in-flight work (drain condition for InferenceServer)."""
+        return self._queue.qsize() + (1 if self._busy else 0)
+
+    def drain(self):
+        """Refuse new requests; queued/in-flight ones keep running."""
+        self._draining.set()
 
     def close(self):
         self._stop.set()
-        self._thread.join(timeout=2)
+        t = self._sup.thread
+        if t is not None:
+            t.join(timeout=2)
+        while True:     # nobody hangs on a closed predictor
+            try:
+                r = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            self._fail(r, ServiceUnavailable("predictor closed",
+                                             retry_after=None))
 
 
 class GenerateBatchingPredictor(BatchingPredictor):
@@ -126,63 +387,90 @@ class GenerateBatchingPredictor(BatchingPredictor):
     and the out-of-bounds-scatter trick drops padding rows from the pool, so
     batching never changes tokens (parity pinned in tests).
 
-    Requests that don't fit the pool are deferred to the next batch (simple
-    admission control); a single request larger than the whole pool errors.
-    """
+    Backpressure: requests that cannot fit the pool RIGHT NOW are deferred to
+    a later batch at most `max_defers` times (blocks free as earlier batches
+    retire), then shed with ServerBusy (HTTP 429 + Retry-After) — a
+    CacheOutOfBlocks never escapes to a whole batch. A request larger than
+    the entire pool is rejected at submit time (ValueError: no retry can
+    fix it). If the pool's shape signature does not match the model, the
+    predictor degrades to the dense generate() path per request instead of
+    launching a paged program that would scatter garbage."""
 
     def __init__(self, model, max_batch_size=8, max_delay_ms=2.0,
                  max_new_tokens=32, kv_cache=None, decode_kernel="pallas",
-                 block_size=32, num_blocks=64):
+                 block_size=32, num_blocks=64, faults=None, admission=None,
+                 breaker=None, max_retries=1, max_defers=8, max_restarts=5):
+        spec = tuple(int(x) for x in model._decode_cache_spec())
         if kv_cache is None:
             from .kv_cache import PagedKVCache
 
-            num_layers, kv_h, hd = model._decode_cache_spec()
+            num_layers, kv_h, hd = spec
             kv_cache = PagedKVCache(num_layers, kv_h, hd,
                                     block_size=block_size,
-                                    num_blocks=num_blocks)
+                                    num_blocks=num_blocks, faults=faults)
         self.model = model
         self.kv_cache = kv_cache
         self.max_new_tokens = int(max_new_tokens)
+        self.max_defers = int(max_defers)
         self.decode_kernel = decode_kernel
+        # paged decode launches against a mismatched pool would scatter into
+        # wrong shapes; degrade to per-request dense generation instead
+        self.fallback_dense = tuple(kv_cache.signature()[:3]) != spec
         self._rid = 0
         super().__init__(predictor=None, max_batch_size=max_batch_size,
-                         max_delay_ms=max_delay_ms)
+                         max_delay_ms=max_delay_ms, faults=faults,
+                         admission=admission, breaker=breaker,
+                         max_retries=max_retries, max_restarts=max_restarts)
 
-    def infer(self, ids, timeout=None):
+    def infer(self, ids, timeout=None, deadline=None):
         """One prompt (1-D int ids) in -> full generated sequence out."""
-        req = _Request([np.asarray(ids)])
-        self._queue.put(req)
-        if not req.event.wait(timeout):
-            raise TimeoutError("generate request timed out")
-        if req.error is not None:
-            raise req.error
-        return req.result
+        req = self._make_request([np.asarray(ids)], timeout, deadline)
+        return self._submit(req)
+
+    def _admission_check(self, arrays):
+        need = self.kv_cache.blocks_for(len(arrays[0]) + self.max_new_tokens)
+        self.admission.admit(self._queue.qsize(), cache=self.kv_cache,
+                             blocks_needed=need)
+
+    # ---------------------------------------------------------------- worker
+    def _shed_or_defer(self, req, error):
+        """Pool-full isolation: THIS request alone waits for blocks or sheds;
+        the rest of its batch proceeds."""
+        if req.deadline is not None and req.deadline.expired():
+            self._fail(req, DeadlineExceeded("deadline expired waiting for "
+                                             "KV blocks"))
+        elif req.defers >= self.max_defers:
+            self._fail(req, ServerBusy(
+                f"KV pool exhausted after {req.defers} deferrals: {error}",
+                retry_after=self.admission.retry_after))
+        else:
+            req.defers += 1
+            self.metrics.inc("deferred")
+            self._queue.put(req)
 
     def _run_batch(self, batch):
-        from .kv_cache import CacheOutOfBlocks
-
-        cache = self.kv_cache
-        admitted, tables, deferred = [], [], []
-        for r in batch:
-            plen = len(r.arrays[0])
-            self._rid += 1
-            rid = ("req", self._rid)
-            try:
-                cache.reserve(rid, plen + self.max_new_tokens)
-                admitted.append((rid, r))
-                tables.append(rid)
-            except CacheOutOfBlocks as e:
-                if not admitted:
-                    r.error = e          # can never fit: fail it loudly
-                    r.event.set()
-                else:
-                    deferred.append(r)   # next batch, after blocks free up
-        if deferred:
-            for r in deferred:
-                self._queue.put(r)
-        if not admitted:
+        if self._faults is not None:
+            self._faults.check("batcher.batch")  # ThreadDeath escapes
+        batch = [r for r in batch if self._usable(r)]
+        if not batch:
             return
+        if self.fallback_dense:
+            return self._run_dense(batch)
+        cache = self.kv_cache
+        admitted: list[tuple] = []
         try:
+            for r in batch:
+                plen = len(r.arrays[0])
+                self._rid += 1
+                rid = ("req", self._rid)
+                try:
+                    cache.reserve(rid, plen + self.max_new_tokens)
+                except CacheOutOfBlocks as e:
+                    self._shed_or_defer(r, e)
+                    continue
+                admitted.append((rid, r))
+            if not admitted:
+                return
             n = len(admitted)
             self.batch_sizes.append(n)
             plens = np.asarray([len(r.arrays[0]) for _, r in admitted],
@@ -195,85 +483,180 @@ class GenerateBatchingPredictor(BatchingPredictor):
                      for p in plens)
             tbl = np.stack([cache.block_table(rid, pad_to=nb)
                             for rid, _ in admitted])
+            if self._faults is not None:
+                self._faults.check("predictor.generate")
+            dls = [r.deadline for _, r in admitted]
+            batch_dl = (max(dls, key=lambda d: d.remaining())
+                        if all(d is not None for d in dls) else None)
             toks = self.model.generate_paged(
                 prompts, plens, cache, tbl,
                 max_new_tokens=self.max_new_tokens,
-                decode_kernel=self.decode_kernel)
+                decode_kernel=self.decode_kernel, deadline=batch_dl)
             toks = np.asarray(toks._value if hasattr(toks, "_value") else toks)
+            self.breaker.record_success()
             for i, (rid, r) in enumerate(admitted):
                 cache.set_length(rid, int(plens[i]) + self.max_new_tokens)
-                r.result = np.concatenate([r.arrays[0],
-                                           toks[i].astype(r.arrays[0].dtype)])
-                r.event.set()
-        except Exception as e:  # pragma: no cover - propagated to callers
+                self._finish_req(r, np.concatenate(
+                    [r.arrays[0], toks[i].astype(r.arrays[0].dtype)]))
+        except Exception as e:
+            self.breaker.record_failure()
+            self.metrics.inc("batch_failures")
             for _, r in admitted:
-                r.error = e
-                r.event.set()
+                self._fail_or_retry(r, e)
         finally:
+            # all-paths release guard: blocks reserved above can never leak,
+            # whatever the batch body did
             for rid, _ in admitted:
-                cache.mark_done(rid)
-                cache.release(rid)
+                try:
+                    cache.mark_done(rid)
+                    cache.release(rid)
+                except KeyError:    # pragma: no cover - evicted already
+                    pass
+
+    def _run_dense(self, batch):
+        """Graceful degradation: per-request dense generate() (correct but
+        unshared-memory) when the paged pool cannot serve this model."""
+        self.metrics.inc("dense_fallback_batches")
+        self.batch_sizes.append(len(batch))
+        dtype = (None if str(self.kv_cache.dtype) == "float32"
+                 else str(self.kv_cache.dtype))
+        for r in batch:
+            try:
+                if self._faults is not None:
+                    self._faults.check("predictor.generate")
+                out = self.model.generate(
+                    r.arrays[0][None], max_new_tokens=self.max_new_tokens,
+                    dtype=dtype, decode_kernel=self.decode_kernel,
+                    deadline=r.deadline)
+                self.breaker.record_success()
+                out = np.asarray(out._value if hasattr(out, "_value")
+                                 else out)[0]
+                self._finish_req(r, out.astype(r.arrays[0].dtype))
+            except Exception as e:
+                self.breaker.record_failure()
+                self.metrics.inc("batch_failures")
+                self._fail_or_retry(r, e)
 
 
 class InferenceServer:
     """HTTP npz endpoint: POST /predict with an .npz body of inputs
-    (x0, x1, ...) -> .npz response of outputs (out0, ...). GET /health."""
+    (x0, x1, ...) -> .npz response of outputs (out0, ...); POST /generate
+    (npz {ids} -> npz {out0}) when a generator is wired in.
+
+    Operational surface (docs/DEPLOYMENT.md "Operations & failure modes"):
+    GET /health (liveness), GET /readyz (readiness: 503 while draining),
+    GET /metrics (JSON terminal-outcome counters + latency tail). Overload
+    answers 429/503 with Retry-After; deadline expiry answers 504; stop()
+    drains in-flight work before tearing the batchers down."""
 
     def __init__(self, predictor, host="127.0.0.1", port=0, batching=True,
-                 max_batch_size=8, max_delay_ms=2.0, generator=None):
+                 max_batch_size=8, max_delay_ms=2.0, generator=None,
+                 default_timeout=30.0, faults=None):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         self.predictor = predictor
         self.batcher = (BatchingPredictor(predictor, max_batch_size,
-                                          max_delay_ms)
+                                          max_delay_ms, faults=faults)
                         if batching and predictor is not None else None)
         # optional token-generation endpoint: a GenerateBatchingPredictor
         # (paged KV serving path) answering POST /generate
         self.generator = generator
+        self.default_timeout = float(default_timeout)
+        self._ready = threading.Event()
+        self._draining = threading.Event()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):  # quiet
                 pass
 
+            def _reply(self, status, body, headers=()):
+                self.send_response(status)
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _fail_http(self, e):
+                """Exception -> status: the client must be able to tell
+                "back off and retry" (429/503 + Retry-After) from "your
+                request is broken" (400) from "you ran out of time" (504)."""
+                headers = []
+                if isinstance(e, Rejected):
+                    status = e.status
+                    if e.retry_after is not None:
+                        headers.append(("Retry-After",
+                                        str(max(1, math.ceil(e.retry_after)))))
+                elif isinstance(e, TimeoutError):
+                    status = 504
+                elif isinstance(e, CacheOutOfBlocks):
+                    status = 503
+                    headers.append(("Retry-After", "1"))
+                elif isinstance(e, ValueError):
+                    status = 400
+                else:
+                    status = 500
+                self._reply(status, repr(e).encode(), headers)
+
+            def _timeout(self):
+                ms = self.headers.get("X-Timeout-Ms")
+                if ms is None:
+                    return outer.default_timeout
+                try:
+                    return min(outer.default_timeout, float(ms) / 1000.0)
+                except ValueError:
+                    return outer.default_timeout
+
             def do_GET(self):
                 if self.path == "/health":
-                    self.send_response(200)
-                    self.end_headers()
-                    self.wfile.write(b"ok")
+                    self._reply(200, b"ok")
+                elif self.path == "/readyz":
+                    if outer._ready.is_set() and not outer._draining.is_set():
+                        self._reply(200, b"ready")
+                    else:
+                        body = (b"draining" if outer._draining.is_set()
+                                else b"not started")
+                        self._reply(503, body, [("Retry-After", "1")])
+                elif self.path == "/metrics":
+                    import json
+
+                    snap = {"draining": outer._draining.is_set()}
+                    if outer.batcher is not None:
+                        snap["batcher"] = outer.batcher.metrics.snapshot()
+                    if outer.generator is not None:
+                        snap["generator"] = outer.generator.metrics.snapshot()
+                    self._reply(200, json.dumps(snap).encode(),
+                                [("Content-Type", "application/json")])
                 else:
-                    self.send_response(404)
-                    self.end_headers()
+                    self._reply(404, b"")
 
             def do_POST(self):
+                if outer._draining.is_set():
+                    self._reply(503, b"draining", [("Retry-After", "1")])
+                    return
                 if self.path == "/generate" and outer.generator is not None:
                     try:
                         n = int(self.headers.get("Content-Length", 0))
                         data = np.load(io.BytesIO(self.rfile.read(n)))
                         ids = data[data.files[0]]
-                        out = outer.generator.infer(ids, timeout=60)
+                        out = outer.generator.infer(ids,
+                                                    timeout=self._timeout())
                         buf = io.BytesIO()
                         np.savez(buf, out0=out)
                         body = buf.getvalue()
-                        self.send_response(200)
-                        self.send_header("Content-Type", "application/npz")
-                        self.send_header("Content-Length", str(len(body)))
-                        self.end_headers()
-                        self.wfile.write(body)
+                        self._reply(200, body,
+                                    [("Content-Type", "application/npz")])
                     except Exception as e:
-                        msg = repr(e).encode()
-                        self.send_response(500)
-                        self.send_header("Content-Length", str(len(msg)))
-                        self.end_headers()
-                        self.wfile.write(msg)
+                        self._fail_http(e)
                     return
                 if self.path != "/predict":
-                    self.send_response(404)
-                    self.end_headers()
+                    self._reply(404, b"")
                     return
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     data = np.load(io.BytesIO(self.rfile.read(n)))
+
                     def _num_key(k):
                         digits = "".join(c for c in k if c.isdigit())
                         return (int(digits) if digits else 0, k)
@@ -281,7 +664,8 @@ class InferenceServer:
                     arrays = [data[k] for k in sorted(data.files,
                                                       key=_num_key)]
                     if outer.batcher is not None:
-                        outs = outer.batcher.infer(*arrays, timeout=30)
+                        outs = outer.batcher.infer(*arrays,
+                                                   timeout=self._timeout())
                     else:
                         outs = [o[0] for o in outer.predictor.run(
                             [a[None] for a in arrays])]
@@ -289,17 +673,10 @@ class InferenceServer:
                     np.savez(buf, **{f"out{i}": o
                                      for i, o in enumerate(outs)})
                     body = buf.getvalue()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "application/npz")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    self._reply(200, body,
+                                [("Content-Type", "application/npz")])
                 except Exception as e:
-                    msg = repr(e).encode()
-                    self.send_response(500)
-                    self.send_header("Content-Length", str(len(msg)))
-                    self.end_headers()
-                    self.wfile.write(msg)
+                    self._fail_http(e)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self._httpd.server_address[1]
@@ -308,12 +685,27 @@ class InferenceServer:
 
     def start(self):
         self._thread.start()
+        self._ready.set()
         return self
 
-    def stop(self):
-        self._httpd.shutdown()
-        if self.batcher is not None:
-            self.batcher.close()
-        if self.generator is not None:
-            self.generator.close()
-        self._thread.join(timeout=2)
+    def stop(self, drain_timeout=5.0):
+        """Graceful drain: flip /readyz to 503 and refuse new POSTs, let
+        queued + in-flight requests finish (up to drain_timeout), then tear
+        down the HTTP loop and the batcher threads."""
+        self._draining.set()
+        self._ready.clear()
+        workers = [w for w in (self.batcher, self.generator)
+                   if w is not None]
+        for w in workers:
+            w.drain()
+        deadline = time.monotonic() + float(drain_timeout)
+        while (time.monotonic() < deadline
+               and any(w.pending() for w in workers)):
+            time.sleep(0.01)
+        if self._thread.is_alive():
+            self._httpd.shutdown()
+        self._httpd.server_close()
+        for w in workers:
+            w.close()
+        if self._thread.is_alive():
+            self._thread.join(timeout=2)

@@ -112,10 +112,21 @@ class GenerationMixin:
             cache = self._generate_cache = {}
         return cache
 
+    @staticmethod
+    def _check_deadline(deadline, where):
+        """Deadline gate at the device-launch boundary: the compiled decode
+        scan cannot be interrupted mid-flight, so a request whose budget is
+        already spent must be refused BEFORE the launch burns a batch slot
+        (serving propagates one Deadline from HTTP -> queue -> here)."""
+        if deadline is not None and deadline.expired():
+            from ..inference.resilience import DeadlineExceeded
+
+            raise DeadlineExceeded(f"deadline expired before {where}")
+
     # ------------------------------------------------------------ dense path
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
                  eos_token_id=None, seed=0, dtype="bfloat16",
-                 decode_kernel=None):
+                 decode_kernel=None, deadline=None):
         """Autoregressive decoding with dense per-layer KV caches.
 
         temperature==0 -> greedy; otherwise softmax sampling with optional
@@ -127,6 +138,8 @@ class GenerationMixin:
         None to keep the parameters' own dtype).
         `decode_kernel`: "xla" (default — grouped-GQA einsum) | "pallas"
         (split-KV flash-decode kernel, ops/pallas/decode_attention.py).
+        `deadline`: optional inference.resilience.Deadline — raises
+        DeadlineExceeded instead of launching an already-expired decode.
         """
         ids = (input_ids._value if isinstance(input_ids, Tensor)
                else jnp.asarray(input_ids))
@@ -192,6 +205,7 @@ class GenerationMixin:
         was_training = self.training
         self.eval()
         try:
+            self._check_deadline(deadline, "dense decode launch")
             return Tensor(run(state, ids, jax.random.key(seed)))
         finally:
             if was_training:
@@ -209,7 +223,8 @@ class GenerationMixin:
     # ------------------------------------------------------------ paged path
     def generate_paged(self, input_ids, prompt_lens, kv_cache, block_tables,
                        max_new_tokens=32, temperature=0.0, top_k=0,
-                       eos_token_id=None, seed=0, decode_kernel="pallas"):
+                       eos_token_id=None, seed=0, decode_kernel="pallas",
+                       deadline=None):
         """Autoregressive decoding over a SHARED paged KV pool.
 
         input_ids: [B, P] prompts right-padded to a common P; prompt_lens [B]
@@ -221,6 +236,10 @@ class GenerationMixin:
 
         Returns [B, max_new_tokens] new tokens (per request b the real
         continuation of input_ids[b, :prompt_lens[b]]).
+
+        `deadline`: optional inference.resilience.Deadline, checked at the
+        launch boundary — the compiled decode scan cannot be interrupted, so
+        an expired budget raises DeadlineExceeded instead of launching.
         """
         ids = (input_ids._value if isinstance(input_ids, Tensor)
                else jnp.asarray(input_ids))
@@ -293,6 +312,7 @@ class GenerationMixin:
         was_training = self.training
         self.eval()
         try:
+            self._check_deadline(deadline, "paged decode launch")
             toks, new_k, new_v = run(
                 state, ids, jnp.asarray(prompt_lens, jnp.int32),
                 jnp.asarray(block_tables, jnp.int32),

@@ -15,3 +15,39 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
+
+import threading  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running soak/perf legs (excluded from tier-1)")
+    config.addinivalue_line(
+        "markers",
+        "chaos: deterministic fault-injection serving legs (tier-1)")
+
+
+# serving tests spin up batcher/server threads; one that leaks a NON-daemon
+# thread would hang the pytest process at exit, so fail the test instead
+_SERVING_TEST_HINTS = ("serving", "chaos", "resilience", "predictor")
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_serving_threads(request):
+    nodeid = request.node.nodeid.lower()
+    if not any(h in nodeid for h in _SERVING_TEST_HINTS):
+        yield
+        return
+    before = set(threading.enumerate())
+    yield
+    leaked = [t for t in threading.enumerate()
+              if t not in before and t.is_alive() and not t.daemon]
+    for t in leaked:        # give closes a beat to land before failing
+        t.join(timeout=1.0)
+    leaked = [t for t in leaked if t.is_alive()]
+    if leaked:
+        pytest.fail(
+            f"serving test leaked non-daemon threads: "
+            f"{[t.name for t in leaked]}")

@@ -1,0 +1,578 @@
+"""Chaos suite (ISSUE-2 acceptance): deterministic fault injection against
+the serving stack. With injected allocator OOM, predictor failures, and
+batcher-thread death, the server must never deadlock, must shed load with
+429/503 + Retry-After, must restart its batcher, and every accepted request
+must reach exactly one terminal outcome within its deadline.
+
+Faults are counter-armed (inference/faults.py), so every leg here is
+reproducible; the storm leg additionally asserts invariants that hold for
+every interleaving (exactly-once terminals, counter conservation, liveness).
+"""
+import io
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.faults import FaultInjector, ThreadDeath
+from paddle_tpu.inference.kv_cache import CacheOutOfBlocks, PagedKVCache
+from paddle_tpu.inference.resilience import (
+    AdmissionController,
+    CircuitBreaker,
+    Rejected,
+    ServerBusy,
+    ServiceUnavailable,
+)
+from paddle_tpu.inference.serving import (
+    BatchingPredictor,
+    GenerateBatchingPredictor,
+    InferenceServer,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+class Doubler:
+    """Model-free predictor: one input array in, input*2 out. Lets the
+    request-lifecycle legs run in milliseconds with no jax in the loop."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def run(self, stacked):
+        self.calls += 1
+        return [stacked[0] * 2.0]
+
+
+def _drain_outcomes(m):
+    return m.get("completed") + m.get("failed") + m.get("timeouts")
+
+
+# ------------------------------------------------------- timeout cancellation
+def test_timed_out_request_is_cancelled_not_computed():
+    """Satellite fix: a timed-out request used to stay enqueued; a later
+    _run_batch computed it anyway and set a result nobody reads. Now the
+    timeout marks it cancelled and collection skips it."""
+    f = FaultInjector()
+    pred = Doubler()
+    bp = BatchingPredictor(pred, max_batch_size=1, max_delay_ms=1, faults=f)
+    try:
+        f.install("predictor.run", delay=0.4, times=1)
+        done = {}
+        t = threading.Thread(
+            target=lambda: done.update(r=bp.infer(np.ones(2), timeout=10)))
+        t.start()
+        deadline = time.monotonic() + 5          # wait until A is in flight
+        while not bp._busy and time.monotonic() < deadline:
+            time.sleep(0.005)
+        with pytest.raises(TimeoutError):        # B expires while A computes
+            bp.infer(np.full(2, 3.0), timeout=0.05)
+        t.join(timeout=10)
+        assert done["r"][0][0] == 2.0
+        out = bp.infer(np.full(2, 5.0), timeout=10)   # C: still serving
+        assert out[0][0] == 10.0
+        # B was never computed: A, C only
+        assert pred.calls == 2
+        assert bp.metrics.get("cancelled_skipped") == 1
+        assert bp.metrics.get("accepted") == 3
+        assert _drain_outcomes(bp.metrics) == 3   # exactly-once terminals
+    finally:
+        bp.close()
+
+
+def test_clock_skew_expires_deadline_without_sleeping():
+    """Deadlines ride the injectable clock: skewing time forward expires a
+    queued request deterministically — no real waiting."""
+    f = FaultInjector()
+    bp = BatchingPredictor(Doubler(), max_batch_size=1, max_delay_ms=1,
+                           faults=f)
+    try:
+        f.install("predictor.run", delay=0.3, times=1)
+
+        def blocked():
+            try:
+                bp.infer(np.ones(2), timeout=30)
+            except TimeoutError:
+                pass    # its deadline rides the same skewed clock
+
+        blocker = threading.Thread(target=blocked)
+        blocker.start()
+        deadline = time.monotonic() + 5
+        while not bp._busy and time.monotonic() < deadline:
+            time.sleep(0.005)
+        start = time.monotonic()
+        err = {}
+
+        def victim():
+            try:
+                bp.infer(np.ones(2), timeout=60)   # nominally a minute
+            except TimeoutError as e:
+                err["e"] = e
+
+        v = threading.Thread(target=victim)
+        v.start()
+        time.sleep(0.05)
+        f.skew_clock(120.0)                        # a "2 minute" GC pause
+        v.join(timeout=5)
+        blocker.join(timeout=5)
+        assert not v.is_alive()
+        assert isinstance(err["e"], TimeoutError)
+        assert time.monotonic() - start < 5.0      # nowhere near 60s
+    finally:
+        bp.close()
+
+
+# -------------------------------------------------------- batcher thread death
+def test_batcher_thread_death_is_healed_and_strands_no_request():
+    f = FaultInjector()
+    pred = Doubler()
+    bp = BatchingPredictor(pred, max_batch_size=2, max_delay_ms=1, faults=f)
+    try:
+        # die once mid-batch, once at the loop tick
+        f.install("batcher.batch", error=ThreadDeath(), times=1)
+        out = bp.infer(np.ones(2), timeout=10)     # survives the mid-batch kill
+        assert out[0][0] == 2.0
+        f.install("batcher.tick", error=ThreadDeath(), times=1)
+        deadline = time.monotonic() + 5            # let the tick kill land
+        while bp._sup.alive() and time.monotonic() < deadline:
+            time.sleep(0.005)
+        out = bp.infer(np.full(2, 2.0), timeout=10)
+        assert out[0][0] == 4.0
+        assert bp.metrics.get("batcher_restarts") == bp._sup.restarts >= 2
+        assert _drain_outcomes(bp.metrics) == bp.metrics.get("accepted") == 2
+    finally:
+        bp.close()
+
+
+def test_dead_batcher_past_restart_budget_sheds_503_not_deadlock():
+    f = FaultInjector()
+    f.install("batcher.tick", error=ThreadDeath(), times=10)  # pre-armed
+    bp = BatchingPredictor(Doubler(), max_batch_size=1, max_delay_ms=1,
+                           faults=f, max_restarts=1)
+    try:
+        with pytest.raises((ServiceUnavailable, TimeoutError)):
+            bp.infer(np.ones(2), timeout=3)
+    finally:
+        bp.close()
+
+
+def test_cancelled_mid_batch_result_is_discarded():
+    """The other half of the timeout satellite: the client gives up while the
+    predictor is mid-call; the computed result loses the terminal CAS and is
+    counted wasted instead of delivered."""
+    f = FaultInjector()
+    pred = Doubler()
+    bp = BatchingPredictor(pred, max_batch_size=1, max_delay_ms=1, faults=f)
+    try:
+        f.install("predictor.run", delay=0.3, times=1)
+        with pytest.raises(TimeoutError):
+            bp.infer(np.ones(2), timeout=0.1)      # cancels mid-predictor-call
+        deadline = time.monotonic() + 10
+        while bp.pending() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert pred.calls == 1                     # it DID compute...
+        assert bp.metrics.get("wasted_results") == 1   # ...for nobody
+        assert bp.metrics.get("completed") == 0
+        assert _drain_outcomes(bp.metrics) == bp.metrics.get("accepted") == 1
+    finally:
+        bp.close()
+
+
+# ----------------------------------------------------- predictor failure paths
+def test_predictor_failure_retries_batch_then_succeeds():
+    f = FaultInjector()
+    pred = Doubler()
+    bp = BatchingPredictor(pred, max_batch_size=4, max_delay_ms=20, faults=f,
+                           max_retries=1)
+    try:
+        f.install("predictor.run", error=RuntimeError("injected crash"),
+                  times=1)
+        results = {}
+        ts = [threading.Thread(
+            target=lambda i=i: results.update(
+                {i: bp.infer(np.full(2, float(i)), timeout=20)}))
+            for i in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=20)
+        for i in range(3):
+            assert results[i][0][0] == 2.0 * i
+        assert bp.metrics.get("batch_failures") == 1
+        assert bp.metrics.get("retries") == 3       # whole batch re-ran once
+        assert _drain_outcomes(bp.metrics) == 3
+    finally:
+        bp.close()
+
+
+def test_circuit_breaker_trips_fails_fast_and_half_open_recovers():
+    f = FaultInjector()
+    pred = Doubler()
+    breaker = CircuitBreaker(failure_threshold=2, reset_after=30.0,
+                             clock=f.monotonic)
+    bp = BatchingPredictor(pred, max_batch_size=1, max_delay_ms=1, faults=f,
+                           breaker=breaker, max_retries=0)
+    try:
+        f.install("predictor.run", error=RuntimeError("injected crash"),
+                  times=2)
+        for _ in range(2):
+            with pytest.raises(RuntimeError):
+                bp.infer(np.ones(2), timeout=10)
+        assert breaker.state == "open"
+        with pytest.raises(ServiceUnavailable) as ei:    # fail-fast, no queue
+            bp.infer(np.ones(2), timeout=10)
+        assert ei.value.retry_after > 0
+        assert bp.metrics.get("rejected_unavailable") == 1
+        f.skew_clock(30.0)                         # cooldown elapses
+        assert breaker.state == "half-open"
+        out = bp.infer(np.ones(2), timeout=10)     # probe succeeds
+        assert out[0][0] == 2.0
+        assert breaker.state == "closed"
+    finally:
+        bp.close()
+
+
+# ------------------------------------------------------------- the fault storm
+def test_every_request_reaches_exactly_one_terminal_outcome_in_storm():
+    """Flagship invariant leg: under crashes + slow calls + a thread death +
+    tight deadlines, every client observes exactly one outcome, the terminal
+    counters conserve, and the predictor still serves afterwards."""
+    f = FaultInjector()
+    pred = Doubler()
+    bp = BatchingPredictor(pred, max_batch_size=4, max_delay_ms=2, faults=f,
+                           max_retries=1,
+                           breaker=CircuitBreaker(failure_threshold=4,
+                                                  reset_after=0.2,
+                                                  clock=f.monotonic))
+    try:
+        f.install("predictor.run", error=RuntimeError("crash"), after=2,
+                  times=2)
+        f.install("predictor.run", delay=0.25, after=6, times=2)
+        f.install("batcher.batch", error=ThreadDeath(), after=4, times=1)
+        N = 24
+        outcomes = [[] for _ in range(N)]
+
+        def client(i):
+            try:
+                r = bp.infer(np.full(2, float(i)),
+                             timeout=(0.15 if i % 5 == 0 else 30))
+                outcomes[i].append(("ok", r))
+            except TimeoutError:
+                outcomes[i].append(("timeout",))
+            except Rejected:
+                outcomes[i].append(("shed",))
+            except Exception as e:   # noqa: BLE001 - storm bookkeeping
+                outcomes[i].append(("fail", e))
+
+        ts = [threading.Thread(target=client, args=(i,)) for i in range(N)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in ts), "a client deadlocked"
+        assert all(len(o) == 1 for o in outcomes), "non-exactly-once outcome"
+        for i, o in enumerate(outcomes):           # no cross-request mixups
+            if o[0][0] == "ok":
+                assert o[0][1][0][0] == 2.0 * i
+        m = bp.metrics
+        assert m.get("accepted") == _drain_outcomes(m)
+        out = bp.infer(np.ones(2), timeout=10)     # still alive afterwards
+        assert out[0][0] == 2.0
+    finally:
+        bp.close()
+
+
+# --------------------------------------------------- generate (paged KV) legs
+@pytest.fixture(scope="module")
+def small_gpt():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    with paddle.utils.unique_name.guard():
+        paddle.seed(7)
+        m = GPTForCausalLM(GPTConfig(vocab_size=128, hidden_size=64,
+                                     num_layers=2, num_heads=4,
+                                     num_kv_heads=2, max_position=64,
+                                     dropout=0.0))
+    m.eval()
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, 128, 5).astype("int64")
+    ref = np.asarray(m.generate(paddle.to_tensor(prompt[None]),
+                                max_new_tokens=3, dtype=None,
+                                decode_kernel="xla")._value)[0]
+    return m, prompt, ref
+
+
+def test_injected_allocator_oom_defers_and_completes(small_gpt):
+    m, prompt, ref = small_gpt
+    f = FaultInjector()
+    gp = GenerateBatchingPredictor(m, max_batch_size=2, max_delay_ms=5,
+                                   max_new_tokens=3, decode_kernel="xla",
+                                   block_size=8, num_blocks=16, faults=f)
+    try:
+        f.install("kv.reserve", error=CacheOutOfBlocks("injected pool-dry"),
+                  times=1)
+        out = gp.infer(prompt, timeout=120)
+        np.testing.assert_array_equal(out, ref)
+        assert gp.metrics.get("deferred") == 1
+        assert gp.kv_cache.blocks_in_use == 0     # no leaked blocks
+    finally:
+        gp.close()
+
+
+def test_allocator_oom_sheds_429_after_defer_budget(small_gpt):
+    m, prompt, _ = small_gpt
+    f = FaultInjector()
+    gp = GenerateBatchingPredictor(m, max_batch_size=2, max_delay_ms=5,
+                                   max_new_tokens=3, decode_kernel="xla",
+                                   block_size=8, num_blocks=16, faults=f,
+                                   max_defers=0)
+    try:
+        f.install("kv.reserve", error=CacheOutOfBlocks("injected pool-dry"),
+                  times=1)
+        with pytest.raises(ServerBusy) as ei:
+            gp.infer(prompt, timeout=120)
+        assert ei.value.status == 429 and ei.value.retry_after is not None
+        assert gp.metrics.get("shed_busy") == 1
+        assert gp.kv_cache.blocks_in_use == 0
+    finally:
+        gp.close()
+
+
+def test_oom_isolated_one_request_fails_alone_batch_completes(small_gpt):
+    """Per-request failure isolation: with the pool sized for ONE request,
+    two concurrent requests still both complete (one defers to the next
+    batch) — a CacheOutOfBlocks never takes down its batchmates."""
+    m, prompt, ref = small_gpt
+    gp = GenerateBatchingPredictor(m, max_batch_size=2, max_delay_ms=30,
+                                   max_new_tokens=3, decode_kernel="xla",
+                                   block_size=8, num_blocks=1)
+    try:
+        results = {}
+        ts = [threading.Thread(
+            target=lambda i=i: results.update(
+                {i: gp.infer(prompt, timeout=180)})) for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=180)
+        for i in range(2):
+            np.testing.assert_array_equal(results[i], ref, err_msg=str(i))
+        assert gp.metrics.get("deferred") >= 1    # second one waited its turn
+        assert gp.kv_cache.blocks_in_use == 0
+    finally:
+        gp.close()
+
+
+def test_generate_timeout_frees_blocks_and_refuses_expired_launch(small_gpt):
+    """GenerateBatchingPredictor half of the timeout satellite: the client
+    times out while the batch is stalled pre-launch; the deadline gate in
+    generate_paged refuses the (now pointless) decode entirely, and every
+    reserved block returns to the pool."""
+    m, prompt, _ = small_gpt
+    f = FaultInjector()
+    gp = GenerateBatchingPredictor(m, max_batch_size=2, max_delay_ms=5,
+                                   max_new_tokens=3, decode_kernel="xla",
+                                   block_size=8, num_blocks=16, faults=f)
+    try:
+        f.install("predictor.generate", delay=0.5, times=1)
+        with pytest.raises(TimeoutError):
+            gp.infer(prompt, timeout=0.1)
+        deadline = time.monotonic() + 30           # batch finishes after us
+        while gp.pending() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert gp.metrics.get("timeouts") == 1
+        assert gp.metrics.get("completed") == 0
+        assert gp.metrics.get("wasted_results") == 0  # launch never happened
+        assert gp.kv_cache.blocks_in_use == 0         # release guard held
+        assert _drain_outcomes(gp.metrics) == gp.metrics.get("accepted") == 1
+    finally:
+        gp.close()
+
+
+def test_generate_predictor_failure_retries_then_succeeds(small_gpt):
+    m, prompt, ref = small_gpt
+    f = FaultInjector()
+    gp = GenerateBatchingPredictor(m, max_batch_size=2, max_delay_ms=5,
+                                   max_new_tokens=3, decode_kernel="xla",
+                                   block_size=8, num_blocks=16, faults=f,
+                                   max_retries=1)
+    try:
+        f.install("predictor.generate",
+                  error=RuntimeError("injected predictor crash"), times=1)
+        out = gp.infer(prompt, timeout=120)
+        np.testing.assert_array_equal(out, ref)
+        assert gp.metrics.get("batch_failures") == 1
+        assert gp.metrics.get("retries") == 1
+        assert gp.kv_cache.blocks_in_use == 0      # release guard held
+    finally:
+        gp.close()
+
+
+def test_signature_mismatch_degrades_to_dense_with_parity(small_gpt):
+    """Paged→dense graceful degradation: a pool whose shape signature does
+    not match the model serves through per-request dense generate() instead
+    of launching a paged program that would scatter garbage."""
+    m, prompt, ref = small_gpt
+    cache = PagedKVCache(2, 4, 16, block_size=8, num_blocks=16,
+                         dtype="float32")          # model wants kv_heads=2
+    gp = GenerateBatchingPredictor(m, max_batch_size=2, max_delay_ms=5,
+                                   max_new_tokens=3, decode_kernel="xla",
+                                   kv_cache=cache)
+    try:
+        assert gp.fallback_dense
+        out = gp.infer(prompt, timeout=120)
+        np.testing.assert_array_equal(out, ref)
+        assert gp.metrics.get("dense_fallback_batches") == 1
+        assert cache.blocks_in_use == 0            # paged pool never touched
+    finally:
+        gp.close()
+
+
+def test_generate_storm_exactly_one_terminal_and_pool_conserved(small_gpt):
+    """Paged-path storm: injected pool-dry + a predictor crash across
+    concurrent mixed clients — exactly-once terminals, counter conservation,
+    zero leaked blocks."""
+    m, prompt, ref = small_gpt
+    f = FaultInjector()
+    gp = GenerateBatchingPredictor(m, max_batch_size=2, max_delay_ms=10,
+                                   max_new_tokens=3, decode_kernel="xla",
+                                   block_size=8, num_blocks=4, faults=f,
+                                   max_retries=1, max_defers=32)
+    try:
+        f.install("kv.reserve", error=CacheOutOfBlocks("injected"), after=1,
+                  times=1)
+        f.install("predictor.generate", error=RuntimeError("injected"),
+                  after=2, times=1)
+        N = 6
+        outcomes = [[] for _ in range(N)]
+
+        def client(i):
+            try:
+                outcomes[i].append(("ok", gp.infer(prompt, timeout=300)))
+            except TimeoutError:
+                outcomes[i].append(("timeout",))
+            except Rejected:
+                outcomes[i].append(("shed",))
+            except Exception as e:   # noqa: BLE001 - storm bookkeeping
+                outcomes[i].append(("fail", e))
+
+        ts = [threading.Thread(target=client, args=(i,)) for i in range(N)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=300)
+        assert not any(t.is_alive() for t in ts), "a client deadlocked"
+        assert all(len(o) == 1 for o in outcomes)
+        for o in outcomes:
+            if o[0][0] == "ok":
+                np.testing.assert_array_equal(o[0][1], ref)
+        assert gp.metrics.get("accepted") == _drain_outcomes(gp.metrics)
+        assert gp.kv_cache.blocks_in_use == 0
+    finally:
+        gp.close()
+
+
+# ------------------------------------------------------------ HTTP server legs
+def _get(base, path):
+    try:
+        r = urllib.request.urlopen(base + path, timeout=10)
+        return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def _post_npz(base, path, ids, headers=None):
+    buf = io.BytesIO()
+    np.savez(buf, ids=ids)
+    req = urllib.request.Request(base + path, data=buf.getvalue(),
+                                 headers=headers or {})
+    try:
+        r = urllib.request.urlopen(req, timeout=60)
+        return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def test_server_readyz_backpressure_and_drain(small_gpt):
+    m, prompt, ref = small_gpt
+    gp = GenerateBatchingPredictor(m, max_batch_size=2, max_delay_ms=5,
+                                   max_new_tokens=3, decode_kernel="xla",
+                                   block_size=8, num_blocks=16)
+    srv = InferenceServer(None, batching=False, generator=gp).start()
+    base = f"http://127.0.0.1:{srv.port}"
+    stopped = False
+    try:
+        assert _get(base, "/health")[0] == 200
+        assert _get(base, "/readyz")[0] == 200
+        status, body, _ = _post_npz(base, "/generate",
+                                    prompt.astype("int64"))
+        assert status == 200
+        np.testing.assert_array_equal(np.load(io.BytesIO(body))["out0"], ref)
+        # /metrics exposes the terminal-outcome counters
+        status, body, hdrs = _get(base, "/metrics")
+        assert status == 200
+        import json
+
+        snap = json.loads(body)
+        assert snap["generator"]["completed"] == 1
+
+        # queue-full backpressure -> 429 + Retry-After (shed at the door)
+        gp.admission = AdmissionController(max_queue_depth=0, retry_after=0.5)
+        status, _, hdrs = _post_npz(base, "/generate", prompt.astype("int64"))
+        assert status == 429 and int(hdrs["Retry-After"]) >= 1
+        gp.admission = AdmissionController()
+
+        # oversized-for-pool request -> 400 (no retry can fix it)
+        big = np.arange(300).astype("int64")       # > 16 blocks * 8 tokens
+        status, _, _ = _post_npz(base, "/generate", big)
+        assert status == 400
+
+        # draining: /readyz flips to 503 and POSTs are refused w/ Retry-After
+        srv._draining.set()
+        assert _get(base, "/readyz")[0] == 503
+        status, _, hdrs = _post_npz(base, "/generate", prompt.astype("int64"))
+        assert status == 503 and "Retry-After" in hdrs
+        srv._draining.clear()
+
+        # graceful stop: finishes in-flight work, then tears down
+        in_flight = {}
+
+        def late_client():
+            in_flight["r"] = _post_npz(base, "/generate",
+                                       prompt.astype("int64"))
+
+        t = threading.Thread(target=late_client)
+        t.start()
+        time.sleep(0.05)
+        srv.stop(drain_timeout=30)
+        stopped = True
+        t.join(timeout=30)
+        status, body, _ = in_flight["r"]
+        assert status in (200, 503)               # served or cleanly refused
+        if status == 200:
+            np.testing.assert_array_equal(
+                np.load(io.BytesIO(body))["out0"], ref)
+    finally:
+        if not stopped:
+            srv.stop(drain_timeout=2)
+
+
+def test_server_maps_timeout_to_504(small_gpt):
+    m, prompt, _ = small_gpt
+    f = FaultInjector()
+    gp = GenerateBatchingPredictor(m, max_batch_size=2, max_delay_ms=5,
+                                   max_new_tokens=3, decode_kernel="xla",
+                                   block_size=8, num_blocks=16, faults=f)
+    srv = InferenceServer(None, batching=False, generator=gp).start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        f.install("predictor.generate", delay=0.5, times=1)
+        status, _, _ = _post_npz(base, "/generate", prompt.astype("int64"),
+                                 headers={"X-Timeout-Ms": "100"})
+        assert status == 504
+    finally:
+        srv.stop(drain_timeout=5)

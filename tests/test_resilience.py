@@ -1,0 +1,261 @@
+"""Unit tests for the serving resilience primitives (ISSUE-2 tentpole) and
+the PagedKVCache atomicity/thread-safety satellites.
+
+Everything here is deterministic: time-dependent behavior (deadlines,
+breaker cooldowns) runs on a fake clock, and the concurrency test asserts
+conservation invariants that hold for every interleaving."""
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.inference.kv_cache import CacheOutOfBlocks, PagedKVCache
+from paddle_tpu.inference.resilience import (
+    AdmissionController,
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceeded,
+    ServerBusy,
+    ServiceUnavailable,
+    ServingMetrics,
+    Supervisor,
+)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+
+# --------------------------------------------------------------- Deadline
+def test_deadline_counts_down_on_injected_clock():
+    clk = FakeClock()
+    dl = Deadline.after(5.0, clk)
+    assert dl.remaining() == pytest.approx(5.0)
+    assert not dl.expired()
+    clk.t = 4.999
+    assert not dl.expired()
+    clk.t = 5.0
+    assert dl.expired()
+    assert dl.remaining() == pytest.approx(0.0)
+
+
+def test_deadline_exceeded_is_a_timeout_error():
+    # pre-existing callers catch TimeoutError; the subclass must satisfy them
+    assert issubclass(DeadlineExceeded, TimeoutError)
+
+
+# --------------------------------------------------------- CircuitBreaker
+def test_breaker_trips_half_opens_and_recovers():
+    clk = FakeClock()
+    br = CircuitBreaker(failure_threshold=2, reset_after=10.0, clock=clk)
+    assert br.state == "closed" and br.allow()
+    br.record_failure()
+    assert br.state == "closed" and br.allow()   # below threshold
+    br.record_failure()
+    assert br.state == "open" and not br.allow()
+    assert br.trips == 1
+    assert br.retry_after() == pytest.approx(10.0)
+    clk.t = 10.0
+    assert br.state == "half-open"
+    assert br.allow()            # exactly one probe
+    assert not br.allow()        # concurrent second call is still fenced
+    br.record_failure()          # probe failed -> re-open, cooldown restarts
+    assert br.state == "open" and not br.allow()
+    clk.t = 20.0
+    assert br.allow()
+    br.record_success()          # probe succeeded -> fully closed
+    assert br.state == "closed" and br.allow()
+
+
+def test_breaker_success_resets_failure_streak():
+    br = CircuitBreaker(failure_threshold=2, reset_after=10.0,
+                        clock=FakeClock())
+    br.record_failure()
+    br.record_success()
+    br.record_failure()
+    assert br.state == "closed"  # non-consecutive failures never trip
+
+
+# ----------------------------------------------------- AdmissionController
+class _PoolStub:
+    num_blocks = 8
+    live_utilization = 0.0
+
+
+def test_admission_rejects_full_queue_with_retry_after():
+    adm = AdmissionController(max_queue_depth=2, retry_after=0.7)
+    adm.admit(1)
+    with pytest.raises(ServerBusy) as ei:
+        adm.admit(2)
+    assert ei.value.retry_after == pytest.approx(0.7)
+    assert ei.value.status == 429
+
+
+def test_admission_rejects_oversized_request_as_permanent():
+    # larger than the whole pool: retrying cannot help -> ValueError, not 429
+    with pytest.raises(ValueError):
+        AdmissionController().admit(0, cache=_PoolStub(), blocks_needed=9)
+
+
+def test_admission_sheds_on_pool_high_water():
+    adm = AdmissionController(high_water=0.9)
+    pool = _PoolStub()
+    pool.live_utilization = 0.95
+    with pytest.raises(ServerBusy):
+        adm.admit(0, cache=pool, blocks_needed=1)
+    pool.live_utilization = 0.5
+    adm.admit(0, cache=pool, blocks_needed=1)   # below high water: admitted
+
+
+# --------------------------------------------------------------- Supervisor
+def test_supervisor_restarts_dead_worker_then_gives_up():
+    spawned = []
+
+    def factory():
+        t = threading.Thread(target=lambda: None, daemon=True)
+        spawned.append(t)
+        return t
+
+    sup = Supervisor(factory, name="w", max_restarts=2)
+    sup.start()
+    sup.thread.join()
+    assert sup.heal() is True and sup.restarts == 1
+    sup.thread.join()
+    assert sup.heal() is True and sup.restarts == 2
+    sup.thread.join()
+    with pytest.raises(ServiceUnavailable):
+        sup.heal()               # restart budget spent: genuinely down
+    assert len(spawned) == 3
+
+
+def test_supervisor_heal_is_noop_while_alive():
+    stop = threading.Event()
+
+    def factory():
+        return threading.Thread(target=stop.wait, daemon=True)
+
+    sup = Supervisor(factory, max_restarts=1)
+    sup.start()
+    try:
+        assert sup.heal() is False
+        assert sup.restarts == 0
+    finally:
+        stop.set()
+        sup.thread.join(timeout=2)
+
+
+# ------------------------------------------------------------ ServingMetrics
+def test_metrics_counters_and_latency_tail():
+    m = ServingMetrics()
+    m.inc("accepted", 3)
+    m.inc("completed")
+    assert m.get("accepted") == 3 and m.get("missing") == 0
+    for ms in range(1, 101):
+        m.observe_latency(ms / 1000.0)
+    snap = m.snapshot()
+    assert snap["accepted"] == 3
+    assert snap["p50_ms"] == pytest.approx(50.0, abs=2.0)
+    assert snap["p99_ms"] == pytest.approx(99.0, abs=2.0)
+    assert snap["p50_ms"] <= snap["p95_ms"] <= snap["p99_ms"]
+
+
+# ------------------------------------------------- PagedKVCache atomicity
+def _cache(num_blocks=8, block_size=4):
+    return PagedKVCache(1, 2, 8, block_size=block_size,
+                        num_blocks=num_blocks, dtype="float32")
+
+
+def test_reserve_failure_is_atomic_no_partial_eviction():
+    """Satellite: the old evict-then-fail path destroyed retained caches and
+    left the pool mutated even when the allocation could never succeed."""
+    cache = _cache(num_blocks=8, block_size=4)
+    cache.reserve("live", 4 * 4)                 # 4 blocks, still decoding
+    cache.reserve("done1", 2 * 4)
+    cache.mark_done("done1")                     # 2 blocks, evictable
+    cache.reserve("done2", 2 * 4)
+    cache.mark_done("done2")                     # 2 blocks, evictable
+    assert cache.free_blocks == 0 and cache.evictable_blocks == 4
+    with pytest.raises(CacheOutOfBlocks):
+        cache.reserve("big", 6 * 4)              # 6 > free(0) + evictable(4)
+    # all-or-nothing: nothing was evicted for the doomed allocation
+    assert set(cache._requests) == {"live", "done1", "done2"}
+    assert cache.blocks_in_use == 8
+    # a request that CAN be covered by eviction still succeeds
+    cache.reserve("ok", 3 * 4)
+    assert cache.blocks_in_use == 4 + 3
+    assert cache.evictable_blocks <= 1
+
+
+def test_live_utilization_ignores_retained_done_requests():
+    cache = _cache(num_blocks=8, block_size=4)
+    cache.reserve("a", 4 * 4)
+    cache.reserve("b", 4 * 4)
+    assert cache.utilization == pytest.approx(1.0)
+    assert cache.live_utilization == pytest.approx(1.0)
+    cache.mark_done("b")
+    assert cache.utilization == pytest.approx(1.0)      # blocks still held
+    assert cache.live_utilization == pytest.approx(0.5)  # but reclaimable
+
+
+def test_paged_kv_concurrent_reserve_release_evict_conserves():
+    """Satellite: reserve/release/evict hammered from many threads — no
+    double-free, and blocks_in_use is conserved for every interleaving."""
+    NUM_BLOCKS = 32
+    cache = _cache(num_blocks=NUM_BLOCKS, block_size=4)
+    errors = []
+
+    def worker(seed):
+        rng = np.random.default_rng(seed)
+        try:
+            for it in range(60):
+                rid = (seed, it)
+                n = int(rng.integers(1, 9))
+                try:
+                    cache.reserve(rid, n * 4)
+                except CacheOutOfBlocks:
+                    continue
+                if rng.random() < 0.5:
+                    # retain done: becomes evictable fodder for other threads
+                    cache.mark_done(rid)
+                else:
+                    cache.release(rid)
+        except Exception as e:  # double-free etc. surfaces here
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(s,)) for s in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not any(t.is_alive() for t in threads)
+    assert errors == []
+    # retained-done stragglers release cleanly exactly once
+    for rid in list(cache._requests):
+        cache.release(rid)
+    assert cache.blocks_in_use == 0
+    assert cache.free_blocks == NUM_BLOCKS
+    free = cache.allocator._free
+    assert len(free) == NUM_BLOCKS and len(set(free)) == NUM_BLOCKS
+
+
+def test_generate_refuses_expired_deadline_before_launch():
+    """Deadline propagation reaches the device-launch boundary: an expired
+    budget refuses the decode instead of burning a compiled-program slot."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    with paddle.utils.unique_name.guard():
+        paddle.seed(7)
+        m = GPTForCausalLM(GPTConfig(vocab_size=64, hidden_size=32,
+                                     num_layers=1, num_heads=2,
+                                     max_position=32, dropout=0.0))
+    m.eval()
+    clk = FakeClock(100.0)
+    expired = Deadline(at=99.0, clock=clk)
+    with pytest.raises(DeadlineExceeded):
+        m.generate(np.zeros((1, 4), np.int64), max_new_tokens=2,
+                   dtype=None, deadline=expired)

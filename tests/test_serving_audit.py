@@ -63,6 +63,41 @@ def test_serving_bench_emits_audit_fields():
     assert "scan_tokens_per_sec" in src
 
 
+def test_pressure_fields_conservation_ok():
+    out = {"accepted": 10, "completed": 7, "failed": 1, "timeouts": 2,
+           "p50_ms": 10.0, "p99_ms": 40.0}
+    bench.serving_pressure_fields(out)
+    assert out["terminal_total"] == 10
+    assert out["conservation"] == "ok"
+    assert out["tail_ratio_p99_p50"] == pytest.approx(4.0)
+
+
+def test_pressure_fields_flag_leaked_requests():
+    # an accepted request that never reached a terminal outcome is the
+    # serving-runtime bug class this PR exists to kill; the bench must name it
+    out = {"accepted": 10, "completed": 9}
+    bench.serving_pressure_fields(out)
+    assert out["terminal_total"] == 9
+    assert out["conservation"] == "leak"
+
+
+def test_pressure_fields_skip_missing_sections():
+    out = {"p50_ms": 10.0}
+    bench.serving_pressure_fields(out)
+    assert "conservation" not in out and "tail_ratio_p99_p50" not in out
+
+
+def test_pressure_bench_wires_conservation_fields():
+    """Source-level pin: bench_serving_pressure must route the predictor's
+    metrics snapshot through serving_pressure_fields (running the pressure
+    leg itself takes minutes on CPU)."""
+    import inspect
+
+    src = inspect.getsource(bench.bench_serving_pressure)
+    assert "serving_pressure_fields(" in src
+    assert "metrics.snapshot()" in src
+
+
 def test_decode_attention_bench_reports_vs_baseline():
     """The decode_attention sub-bench must report the Pallas-vs-XLA ratio
     under the contract key `vs_baseline` for every shape entry."""
