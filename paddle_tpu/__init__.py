@@ -57,6 +57,7 @@ from . import utils  # noqa: E402
 from . import profiler  # noqa: E402
 from . import static  # noqa: E402
 from . import inference  # noqa: E402
+from . import observability  # noqa: E402
 from . import fft  # noqa: E402
 from . import sparse  # noqa: E402
 from . import audio  # noqa: E402

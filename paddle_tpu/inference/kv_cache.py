@@ -113,6 +113,8 @@ class PagedKVCache:
         self._requests: dict = {}
         self._clock = itertools.count()
         self._faults = faults
+        self.evictions = 0          # finished-but-retained requests reclaimed
+        self.evicted_blocks = 0     # blocks those evictions returned
         # host bookkeeping is hit from HTTP handler threads (admission
         # checks), the batcher thread (reserve/release), and clients
         # (gather); RLock because reserve -> _evict_lru -> release re-enters
@@ -126,6 +128,45 @@ class PagedKVCache:
 
     def blocks_for(self, seq_len: int) -> int:
         return max(1, math.ceil(seq_len / self.block_size))
+
+    # ---------------------------------------------------------- observability
+    def bind_metrics(self, registry, pool="kv"):
+        """Register this pool's state on a MetricsRegistry
+        (paddle_tpu/observability/metrics.py) as callback-read series —
+        sampled at scrape time, no bookkeeping on the allocation hot path:
+
+        * ``paddle_kv_pool_blocks{pool=...,state=live|free|evictable}``
+        * ``paddle_kv_pool_live_utilization{pool=...}`` (admission signal)
+        * ``paddle_kv_pool_evictions_total{pool=...}`` (monotonic)
+
+        "live" counts still-decoding blocks (in_use minus evictable), so the
+        three states partition the pool: live + free + evictable ==
+        num_blocks, which the exposition-lint test checks off the scrape."""
+        blocks = registry.gauge(
+            "paddle_kv_pool_blocks",
+            "KV page-pool blocks by state; live+free+evictable == pool size",
+            labels=("pool", "state"))
+        blocks.labels(pool, "live").set_function(
+            lambda: self.blocks_in_use - self.evictable_blocks)
+        blocks.labels(pool, "free").set_function(lambda: self.free_blocks)
+        blocks.labels(pool, "evictable").set_function(
+            lambda: self.evictable_blocks)
+        registry.gauge(
+            "paddle_kv_pool_size_blocks", "Total blocks in the KV page pool",
+            labels=("pool",)).labels(pool).set_function(
+                lambda: self.num_blocks)
+        registry.gauge(
+            "paddle_kv_pool_live_utilization",
+            "Fraction of the pool held by still-decoding requests "
+            "(the admission-control pressure signal)",
+            labels=("pool",)).labels(pool).set_function(
+                lambda: self.live_utilization)
+        registry.counter(
+            "paddle_kv_pool_evictions_total",
+            "Finished-but-retained requests evicted LRU to cover new "
+            "reservations", labels=("pool",)).labels(pool).set_function(
+                lambda: self.evictions)
+        return self
 
     # ----------------------------------------------------------- allocation
     def reserve(self, request_id, max_seq_len: int, evict: bool = True):
@@ -166,6 +207,8 @@ class PagedKVCache:
                 if freed >= need:
                     break
                 freed += len(req.blocks)
+                self.evictions += 1
+                self.evicted_blocks += len(req.blocks)
                 self.release(rid)
 
     def mark_done(self, request_id):

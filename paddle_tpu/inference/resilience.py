@@ -26,8 +26,11 @@ skewing time instead of sleeping (see inference/faults.py).
 """
 from __future__ import annotations
 
+import random
 import threading
 import time
+
+from ..observability.metrics import DEFAULT_LATENCY_BUCKETS, MetricsRegistry
 
 __all__ = [
     "DeadlineExceeded", "Rejected", "ServerBusy", "ServiceUnavailable",
@@ -225,34 +228,70 @@ class Supervisor:
 
 
 class ServingMetrics:
-    """Terminal-outcome counters + latency reservoir.
+    """Terminal-outcome counters + latency tail, re-based on the typed
+    observability registry (paddle_tpu/observability/metrics.py).
 
     Conservation contract (pinned by the chaos tests and the pressure
     bench): every ACCEPTED request increments exactly one of
     ``completed`` / ``failed`` / ``timeouts``; admission rejections increment
     ``rejected_busy`` / ``rejected_unavailable`` instead and are never
     accepted. Anything else (deferred, retries, ...) is free-running
-    telemetry outside the conservation sum."""
+    telemetry outside the conservation sum.
+
+    Every ``inc``/``observe_latency`` ALSO lands in the Prometheus registry:
+    counters as ``paddle_serving_events_total{component=...,event=...}``
+    (the conservation sum is therefore checkable straight off the /metrics
+    exposition) and latencies as the
+    ``paddle_serving_request_latency_seconds`` histogram. The legacy
+    ``snapshot()`` JSON shape is unchanged.
+
+    The latency reservoir is a UNIFORM sample (Vitter's algorithm R): with
+    the old append-until-full buffer, sample 4097+ was silently dropped and
+    p99 froze minutes into a long run — late-arriving tail latencies now
+    displace random earlier samples so the percentiles keep tracking the
+    live distribution."""
 
     _LAT_CAP = 4096
 
-    def __init__(self):
+    def __init__(self, registry=None, component="serving", rng=None):
         self._lock = threading.Lock()
         self._counters: dict[str, int] = {}
         self._latencies: list[float] = []
+        self._lat_seen = 0                      # total observations ever
+        self._rng = rng if rng is not None else random.Random(0x7A11)
+        self.component = str(component)
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self._prom_events = self.registry.counter(
+            "paddle_serving_events_total",
+            "Serving lifecycle events by component; conservation: "
+            "accepted == completed + failed + timeouts",
+            labels=("component", "event"))
+        self._prom_latency = self.registry.histogram(
+            "paddle_serving_request_latency_seconds",
+            "Accepted-request latency to terminal outcome",
+            labels=("component",), buckets=DEFAULT_LATENCY_BUCKETS)
 
     def inc(self, name, n=1):
         with self._lock:
             self._counters[name] = self._counters.get(name, 0) + n
+        self._prom_events.labels(self.component, name).inc(n)
 
     def get(self, name) -> int:
         with self._lock:
             return self._counters.get(name, 0)
 
     def observe_latency(self, seconds):
+        v = float(seconds)
         with self._lock:
+            self._lat_seen += 1
             if len(self._latencies) < self._LAT_CAP:
-                self._latencies.append(float(seconds))
+                self._latencies.append(v)
+            else:
+                # Vitter R: keep each of the n samples with P = CAP/n
+                j = self._rng.randrange(self._lat_seen)
+                if j < self._LAT_CAP:
+                    self._latencies[j] = v
+        self._prom_latency.labels(self.component).observe(v)
 
     @staticmethod
     def _pct(sorted_vals, q):

@@ -29,6 +29,8 @@ import time
 
 import numpy as np
 
+from ..observability.metrics import MetricsRegistry, render_prometheus
+from ..observability.trace import RequestTrace, Tracer, new_trace_id
 from .faults import ThreadDeath
 from .kv_cache import CacheOutOfBlocks
 from .resilience import (
@@ -57,9 +59,9 @@ class _Request:
     mutually exclusive instead of racy."""
 
     __slots__ = ("arrays", "event", "result", "error", "deadline", "retries",
-                 "defers", "t0", "_lock", "_state")
+                 "defers", "t0", "trace", "enq_us", "_lock", "_state")
 
-    def __init__(self, arrays, deadline=None):
+    def __init__(self, arrays, deadline=None, trace=None):
         self.arrays = arrays
         self.deadline = deadline
         self.event = threading.Event()
@@ -68,6 +70,8 @@ class _Request:
         self.retries = 0        # failed-batch re-runs consumed
         self.defers = 0         # pool-full next-batch deferrals consumed
         self.t0 = None
+        self.trace = trace      # observability.trace.RequestTrace | None
+        self.enq_us = None      # queue-entry stamp (tracer µs) of this pass
         self._lock = threading.Lock()
         self._state = _PENDING
 
@@ -116,16 +120,23 @@ class BatchingPredictor:
     thread if it dies (clients waiting in `_await` drive the restart, so a
     dead batcher with a full queue heals without a watchdog thread)."""
 
+    _component = "batcher"      # prometheus `component` label value
+
     def __init__(self, predictor, max_batch_size=8, max_delay_ms=2.0,
                  faults=None, admission=None, breaker=None, max_retries=1,
-                 max_restarts=5):
+                 max_restarts=5, tracer=None, registry=None):
         self.predictor = predictor
         self.max_batch_size = int(max_batch_size)
         self.max_delay = max_delay_ms / 1000.0
         self.max_retries = int(max_retries)
         self._faults = faults
         self._clock = faults.monotonic if faults is not None else time.monotonic
-        self.metrics = ServingMetrics()
+        # observability: request-scoped spans (trace.py) + typed registry
+        # (metrics.py). Pass Tracer(enabled=False) to serve untraced — the
+        # bench's observability_overhead leg measures exactly that delta.
+        self.tracer = tracer if tracer is not None else Tracer()
+        self.metrics = ServingMetrics(registry=registry,
+                                      component=self._component)
         self.admission = admission if admission is not None \
             else AdmissionController()
         self.breaker = breaker if breaker is not None else CircuitBreaker(
@@ -150,26 +161,37 @@ class BatchingPredictor:
             pass    # worker dies (supervisor will heal) without excepthook noise
 
     # ---------------------------------------------------------------- client
-    def infer(self, *arrays, timeout=None, deadline=None):
+    def infer(self, *arrays, timeout=None, deadline=None, trace_id=None):
         """One logical sample in (arrays WITHOUT the batch dim), one out.
 
         `timeout` seconds become a Deadline that rides with the request
         through the queue and into the batch (`deadline` passes one in
         directly); expiry anywhere raises DeadlineExceeded (a TimeoutError)
-        here, exactly once, with the queue slot reclaimed."""
+        here, exactly once, with the queue slot reclaimed. `trace_id` joins
+        the request to an existing trace (HTTP `X-Trace-Id` propagation);
+        omitted, a fresh trace is minted."""
         req = self._make_request([np.asarray(a) for a in arrays],
-                                 timeout, deadline)
+                                 timeout, deadline, trace_id)
         return self._submit(req)
 
-    def _make_request(self, arrays, timeout, deadline):
+    def _make_request(self, arrays, timeout, deadline, trace_id=None):
         if deadline is None and timeout is not None:
             deadline = Deadline.after(float(timeout), self._clock)
-        return _Request(arrays, deadline)
+        return _Request(arrays, deadline,
+                        trace=RequestTrace(self.tracer, trace_id))
 
     def _admission_check(self, arrays):
         self.admission.admit(self._queue.qsize())
 
+    def _enqueue(self, req):
+        """Queue entry point (first pass AND defer/retry/death re-passes):
+        stamps the queue-wait span start before handing to the batcher."""
+        req.enq_us = req.trace.now_us() if req.trace is not None else None
+        self._queue.put(req)
+
     def _submit(self, req):
+        tr = req.trace
+        t_adm = tr.now_us()
         try:
             if self._stop.is_set() or self._draining.is_set():
                 raise ServiceUnavailable("predictor is shutting down",
@@ -184,13 +206,18 @@ class BatchingPredictor:
         except Rejected as e:
             self.metrics.inc("rejected_busy" if isinstance(e, ServerBusy)
                              else "rejected_unavailable")
+            tr.child("admission", t_adm, tr.now_us(), error=repr(e))
+            tr.finish("rejected", status=e.status, error=repr(e))
             raise
-        except ValueError:   # malformed/oversized: no retry can fix it
+        except ValueError as e:  # malformed/oversized: no retry can fix it
             self.metrics.inc("rejected_invalid")
+            tr.child("admission", t_adm, tr.now_us(), error=repr(e))
+            tr.finish("rejected", status=400, error=repr(e))
             raise
+        tr.child("admission", t_adm, tr.now_us())
         self.metrics.inc("accepted")
         req.t0 = self._clock()
-        self._queue.put(req)
+        self._enqueue(req)
         return self._await(req)
 
     def _await(self, req):
@@ -204,6 +231,9 @@ class BatchingPredictor:
                     if req.cancel():
                         self.metrics.inc("timeouts")
                         self._observe(req)
+                        if req.trace is not None:
+                            req.trace.finish("timeout", cas="timeout",
+                                             where="client_wait")
                         raise DeadlineExceeded("inference request timed out")
                     break   # lost the race: a terminal outcome just landed
                 step = min(0.1, rem)
@@ -228,9 +258,13 @@ class BatchingPredictor:
         if req.finish(result):
             self.metrics.inc("completed")
             self._observe(req)
+            if req.trace is not None:
+                req.trace.finish("result", cas="result")
             return True
         # computed a result nobody will read (client cancelled mid-batch)
         self.metrics.inc("wasted_results")
+        if req.trace is not None:
+            req.trace.event("wasted_result")
         return False
 
     def _fail(self, req, error) -> bool:
@@ -238,13 +272,19 @@ class BatchingPredictor:
             return False
         if isinstance(error, DeadlineExceeded):
             self.metrics.inc("timeouts")
+            terminal = "timeout"
         else:
             self.metrics.inc("failed")
+            terminal = "error"
             if isinstance(error, ServerBusy):
                 self.metrics.inc("shed_busy")
+                terminal = "shed"
             elif isinstance(error, ServiceUnavailable):
                 self.metrics.inc("shed_unavailable")
+                terminal = "shed"
         self._observe(req)
+        if req.trace is not None:
+            req.trace.finish(terminal, cas=terminal, error=repr(error))
         return True
 
     def _fail_or_retry(self, req, error):
@@ -258,7 +298,10 @@ class BatchingPredictor:
                          and req.deadline.expired())):
             req.retries += 1
             self.metrics.inc("retries")
-            self._queue.put(req)
+            if req.trace is not None:
+                req.trace.event("retry", attempt=req.retries,
+                                error=repr(error))
+            self._enqueue(req)
         else:
             self._fail(req, error)
 
@@ -272,6 +315,10 @@ class BatchingPredictor:
                 self.metrics.inc("cancelled_skipped")
             return False
         if req.deadline is not None and req.deadline.expired():
+            if req.trace is not None and req.enq_us is not None:
+                req.trace.child("queue_wait", req.enq_us,
+                                req.trace.now_us(), expired=True)
+                req.enq_us = None
             if self._fail(req, DeadlineExceeded("deadline expired in queue")):
                 self.metrics.inc("expired_in_queue")
             return False
@@ -294,13 +341,20 @@ class BatchingPredictor:
                 continue
             self._busy = True
             try:
+                t_as = self.tracer.now_us() if self.tracer.enabled else 0.0
                 batch = self._collect(first)
+                if self.tracer.enabled and batch:
+                    t_as1 = self.tracer.now_us()
+                    for r in batch:     # batch-level span, in each member's
+                        if r.trace is not None:  # trace (shared batch tags)
+                            r.trace.child("batch_assembly", t_as, t_as1,
+                                          batch_size=len(batch))
                 try:
                     self._run_batch(batch)
                 except ThreadDeath:
                     for r in batch:     # the dying thread strands no work
                         if r.state == _PENDING:
-                            self._queue.put(r)
+                            self._enqueue(r)
                     raise
             finally:
                 self._busy = False
@@ -323,12 +377,33 @@ class BatchingPredictor:
                 batch.append(r)
         return batch
 
+    def _end_queue_wait(self, batch):
+        """Close each collected request's queue-wait span (re-opened by
+        _enqueue on defer/retry re-passes)."""
+        if not self.tracer.enabled:
+            return
+        now = self.tracer.now_us()
+        for r in batch:
+            if r.trace is not None and r.enq_us is not None:
+                r.trace.child("queue_wait", r.enq_us, now)
+                r.enq_us = None
+
+    def _span_each(self, batch, name, start_us, end_us, **tags):
+        """Record one batch-level interval under every member's trace."""
+        if not self.tracer.enabled:
+            return
+        for r in batch:
+            if r.trace is not None:
+                r.trace.child(name, start_us, end_us, **tags)
+
     def _run_batch(self, batch):
         if self._faults is not None:
             self._faults.check("batcher.batch")  # ThreadDeath escapes
         batch = [r for r in batch if self._usable(r)]
         if not batch:
             return
+        self._end_queue_wait(batch)
+        t_launch0 = self.tracer.now_us() if self.tracer.enabled else 0.0
         try:
             n = len(batch)
             bucket = self._bucket(n)
@@ -342,13 +417,20 @@ class BatchingPredictor:
                 stacked.append(arr)
             if self._faults is not None:
                 self._faults.check("predictor.run")
+            t_dec = self.tracer.now_us() if self.tracer.enabled else 0.0
             outs = self.predictor.run(stacked)
             self.breaker.record_success()
+            self._span_each(batch, "decode_launch", t_launch0, t_dec,
+                            batch_size=n, bucket=bucket)
+            self._span_each(batch, "decode", t_dec, self.tracer.now_us(),
+                            batch_size=n)
             for j, r in enumerate(batch):
                 self._finish_req(r, [o[j] for o in outs])
         except Exception as e:
             self.breaker.record_failure()
             self.metrics.inc("batch_failures")
+            self._span_each(batch, "decode", t_launch0, self.tracer.now_us(),
+                            error=repr(e))
             for r in batch:
                 self._fail_or_retry(r, e)
 
@@ -396,10 +478,13 @@ class GenerateBatchingPredictor(BatchingPredictor):
     predictor degrades to the dense generate() path per request instead of
     launching a paged program that would scatter garbage."""
 
+    _component = "generator"
+
     def __init__(self, model, max_batch_size=8, max_delay_ms=2.0,
                  max_new_tokens=32, kv_cache=None, decode_kernel="pallas",
                  block_size=32, num_blocks=64, faults=None, admission=None,
-                 breaker=None, max_retries=1, max_defers=8, max_restarts=5):
+                 breaker=None, max_retries=1, max_defers=8, max_restarts=5,
+                 tracer=None, registry=None):
         spec = tuple(int(x) for x in model._decode_cache_spec())
         if kv_cache is None:
             from .kv_cache import PagedKVCache
@@ -420,11 +505,30 @@ class GenerateBatchingPredictor(BatchingPredictor):
         super().__init__(predictor=None, max_batch_size=max_batch_size,
                          max_delay_ms=max_delay_ms, faults=faults,
                          admission=admission, breaker=breaker,
-                         max_retries=max_retries, max_restarts=max_restarts)
+                         max_retries=max_retries, max_restarts=max_restarts,
+                         tracer=tracer, registry=registry)
+        # pool state scrapes through the shared registry (live/free/evictable
+        # gauges + eviction counter), decode launches feed the histogram below
+        kv_cache.bind_metrics(self.metrics.registry, pool=self._component)
+        self._decode_hist = self.metrics.registry.histogram(
+            "paddle_decode_launch_seconds",
+            "Host wall of one decode launch (prefill + compiled scan "
+            "dispatch) by path", labels=("component", "path"))
+        self._tokens_total = self.metrics.registry.counter(
+            "paddle_generated_tokens_total", "Tokens generated (batch * new)",
+            labels=("component",))
 
-    def infer(self, ids, timeout=None, deadline=None):
+    def _gen_timing(self, info):
+        """models/generation.py timing hook -> registry series."""
+        self._decode_hist.labels(self._component, info["path"]).observe(
+            info["launch_s"])
+        self._tokens_total.labels(self._component).inc(
+            info["batch"] * info["new_tokens"])
+
+    def infer(self, ids, timeout=None, deadline=None, trace_id=None):
         """One prompt (1-D int ids) in -> full generated sequence out."""
-        req = self._make_request([np.asarray(ids)], timeout, deadline)
+        req = self._make_request([np.asarray(ids)], timeout, deadline,
+                                 trace_id)
         return self._submit(req)
 
     def _admission_check(self, arrays):
@@ -446,7 +550,10 @@ class GenerateBatchingPredictor(BatchingPredictor):
         else:
             req.defers += 1
             self.metrics.inc("deferred")
-            self._queue.put(req)
+            if req.trace is not None:
+                req.trace.event("deferred", attempt=req.defers,
+                                error=repr(error))
+            self._enqueue(req)
 
     def _run_batch(self, batch):
         if self._faults is not None:
@@ -456,6 +563,9 @@ class GenerateBatchingPredictor(BatchingPredictor):
             return
         if self.fallback_dense:
             return self._run_dense(batch)
+        self._end_queue_wait(batch)
+        traced = self.tracer.enabled
+        t_launch0 = self.tracer.now_us() if traced else 0.0
         cache = self.kv_cache
         admitted: list[tuple] = []
         try:
@@ -463,11 +573,19 @@ class GenerateBatchingPredictor(BatchingPredictor):
                 plen = len(r.arrays[0])
                 self._rid += 1
                 rid = ("req", self._rid)
+                t_kv = self.tracer.now_us() if traced else 0.0
                 try:
                     cache.reserve(rid, plen + self.max_new_tokens)
                 except CacheOutOfBlocks as e:
+                    if traced and r.trace is not None:
+                        r.trace.child("kv_reserve", t_kv,
+                                      self.tracer.now_us(), error=repr(e))
                     self._shed_or_defer(r, e)
                     continue
+                if traced and r.trace is not None:
+                    r.trace.child(
+                        "kv_reserve", t_kv, self.tracer.now_us(),
+                        blocks=cache.blocks_for(plen + self.max_new_tokens))
                 admitted.append((rid, r))
             if not admitted:
                 return
@@ -488,12 +606,20 @@ class GenerateBatchingPredictor(BatchingPredictor):
             dls = [r.deadline for _, r in admitted]
             batch_dl = (max(dls, key=lambda d: d.remaining())
                         if all(d is not None for d in dls) else None)
+            t_dec = self.tracer.now_us() if traced else 0.0
             toks = self.model.generate_paged(
                 prompts, plens, cache, tbl,
                 max_new_tokens=self.max_new_tokens,
-                decode_kernel=self.decode_kernel, deadline=batch_dl)
+                decode_kernel=self.decode_kernel, deadline=batch_dl,
+                timing_hook=self._gen_timing)
             toks = np.asarray(toks._value if hasattr(toks, "_value") else toks)
             self.breaker.record_success()
+            adm = [r for _, r in admitted]
+            self._span_each(adm, "decode_launch", t_launch0, t_dec,
+                            batch_size=n)
+            self._span_each(adm, "decode", t_dec, self.tracer.now_us(),
+                            batch_size=n, path="paged",
+                            kernel=self.decode_kernel)
             for i, (rid, r) in enumerate(admitted):
                 cache.set_length(rid, int(plens[i]) + self.max_new_tokens)
                 self._finish_req(r, np.concatenate(
@@ -501,6 +627,8 @@ class GenerateBatchingPredictor(BatchingPredictor):
         except Exception as e:
             self.breaker.record_failure()
             self.metrics.inc("batch_failures")
+            self._span_each([r for _, r in admitted], "decode", t_launch0,
+                            self.tracer.now_us(), error=repr(e))
             for _, r in admitted:
                 self._fail_or_retry(r, e)
         finally:
@@ -518,23 +646,29 @@ class GenerateBatchingPredictor(BatchingPredictor):
         unshared-memory) when the paged pool cannot serve this model."""
         self.metrics.inc("dense_fallback_batches")
         self.batch_sizes.append(len(batch))
+        self._end_queue_wait(batch)
         dtype = (None if str(self.kv_cache.dtype) == "float32"
                  else str(self.kv_cache.dtype))
         for r in batch:
+            t_dec = self.tracer.now_us() if self.tracer.enabled else 0.0
             try:
                 if self._faults is not None:
                     self._faults.check("predictor.generate")
                 out = self.model.generate(
                     r.arrays[0][None], max_new_tokens=self.max_new_tokens,
                     dtype=dtype, decode_kernel=self.decode_kernel,
-                    deadline=r.deadline)
+                    deadline=r.deadline, timing_hook=self._gen_timing)
                 self.breaker.record_success()
                 out = np.asarray(out._value if hasattr(out, "_value")
                                  else out)[0]
+                self._span_each([r], "decode", t_dec, self.tracer.now_us(),
+                                path="dense_fallback")
                 self._finish_req(r, out.astype(r.arrays[0].dtype))
             except Exception as e:
                 self.breaker.record_failure()
                 self.metrics.inc("batch_failures")
+                self._span_each([r], "decode", t_dec, self.tracer.now_us(),
+                                error=repr(e))
                 self._fail_or_retry(r, e)
 
 
@@ -545,18 +679,24 @@ class InferenceServer:
 
     Operational surface (docs/DEPLOYMENT.md "Operations & failure modes"):
     GET /health (liveness), GET /readyz (readiness: 503 while draining),
-    GET /metrics (JSON terminal-outcome counters + latency tail). Overload
-    answers 429/503 with Retry-After; deadline expiry answers 504; stop()
-    drains in-flight work before tearing the batchers down."""
+    GET /metrics (legacy JSON counters; `?format=prom` or an Accept header
+    naming text/plain serves the Prometheus text exposition of the full
+    observability registry). Overload answers 429/503 with Retry-After;
+    deadline expiry answers 504; stop() drains in-flight work before tearing
+    the batchers down. EVERY response (success and every error path) carries
+    `X-Trace-Id` — minted here, or propagated from the client's own
+    `X-Trace-Id` request header — so a 504 in a client log joins the
+    server-side trace (`tracer.trace(id)`) without guesswork."""
 
     def __init__(self, predictor, host="127.0.0.1", port=0, batching=True,
                  max_batch_size=8, max_delay_ms=2.0, generator=None,
-                 default_timeout=30.0, faults=None):
+                 default_timeout=30.0, faults=None, tracer=None):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         self.predictor = predictor
         self.batcher = (BatchingPredictor(predictor, max_batch_size,
-                                          max_delay_ms, faults=faults)
+                                          max_delay_ms, faults=faults,
+                                          tracer=tracer)
                         if batching and predictor is not None else None)
         # optional token-generation endpoint: a GenerateBatchingPredictor
         # (paged KV serving path) answering POST /generate
@@ -564,16 +704,45 @@ class InferenceServer:
         self.default_timeout = float(default_timeout)
         self._ready = threading.Event()
         self._draining = threading.Event()
+        # server-level registry: HTTP surface + lifecycle state; /metrics
+        # merges it with the batcher/generator registries into ONE exposition
+        self.registry = MetricsRegistry()
+        self.registry.gauge(
+            "paddle_server_draining",
+            "1 while draining (readyz answers 503)").set_function(
+                lambda: 1 if self._draining.is_set() else 0)
+        self._http_responses = self.registry.counter(
+            "paddle_http_responses_total", "HTTP responses by path and status",
+            labels=("path", "status"))
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):  # quiet
                 pass
 
+            def _trace_id(self):
+                """One trace id per HTTP request: the client's X-Trace-Id if
+                it sent one (cross-service propagation), else minted here."""
+                tid = getattr(self, "_tid", None)
+                if tid is None:
+                    tid = self.headers.get("X-Trace-Id") or new_trace_id()
+                    self._tid = tid
+                return tid
+
+            def _metric_path(self):
+                p = self.path.split("?", 1)[0]
+                return p if p in ("/health", "/readyz", "/metrics",
+                                  "/predict", "/generate") else "other"
+
             def _reply(self, status, body, headers=()):
+                # count BEFORE writing: a client that saw the response must
+                # never scrape a /metrics page that hasn't counted it yet
+                outer._http_responses.labels(self._metric_path(),
+                                             str(status)).inc()
                 self.send_response(status)
                 for k, v in headers:
                     self.send_header(k, v)
+                self.send_header("X-Trace-Id", self._trace_id())
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -581,13 +750,16 @@ class InferenceServer:
             def _fail_http(self, e):
                 """Exception -> status: the client must be able to tell
                 "back off and retry" (429/503 + Retry-After) from "your
-                request is broken" (400) from "you ran out of time" (504)."""
+                request is broken" (400) from "you ran out of time" (504).
+                Every load-shed status carries Retry-After (a Rejected with
+                no hint still gets the 1s floor — a 429/503 without
+                Retry-After makes clients invent their own backoff)."""
                 headers = []
                 if isinstance(e, Rejected):
                     status = e.status
-                    if e.retry_after is not None:
-                        headers.append(("Retry-After",
-                                        str(max(1, math.ceil(e.retry_after)))))
+                    retry = e.retry_after if e.retry_after is not None else 1
+                    headers.append(("Retry-After",
+                                    str(max(1, math.ceil(retry)))))
                 elif isinstance(e, TimeoutError):
                     status = 504
                 elif isinstance(e, CacheOutOfBlocks):
@@ -609,16 +781,29 @@ class InferenceServer:
                     return outer.default_timeout
 
             def do_GET(self):
-                if self.path == "/health":
+                path, _, query = self.path.partition("?")
+                if path == "/health":
                     self._reply(200, b"ok")
-                elif self.path == "/readyz":
+                elif path == "/readyz":
                     if outer._ready.is_set() and not outer._draining.is_set():
                         self._reply(200, b"ready")
                     else:
                         body = (b"draining" if outer._draining.is_set()
                                 else b"not started")
                         self._reply(503, body, [("Retry-After", "1")])
-                elif self.path == "/metrics":
+                elif path == "/metrics":
+                    accept = self.headers.get("Accept", "")
+                    if ("format=prom" in query or "text/plain" in accept
+                            or "openmetrics" in accept):
+                        try:
+                            body = outer.render_prometheus().encode()
+                        except ValueError as e:   # conflicting registries
+                            self._fail_http(e)
+                            return
+                        self._reply(200, body, [
+                            ("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")])
+                        return
                     import json
 
                     snap = {"draining": outer._draining.is_set()}
@@ -641,7 +826,8 @@ class InferenceServer:
                         data = np.load(io.BytesIO(self.rfile.read(n)))
                         ids = data[data.files[0]]
                         out = outer.generator.infer(ids,
-                                                    timeout=self._timeout())
+                                                    timeout=self._timeout(),
+                                                    trace_id=self._trace_id())
                         buf = io.BytesIO()
                         np.savez(buf, out0=out)
                         body = buf.getvalue()
@@ -665,7 +851,8 @@ class InferenceServer:
                                                       key=_num_key)]
                     if outer.batcher is not None:
                         outs = outer.batcher.infer(*arrays,
-                                                   timeout=self._timeout())
+                                                   timeout=self._timeout(),
+                                                   trace_id=self._trace_id())
                     else:
                         outs = [o[0] for o in outer.predictor.run(
                             [a[None] for a in arrays])]
@@ -682,6 +869,18 @@ class InferenceServer:
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True, name="inference-server")
+
+    def render_prometheus(self) -> str:
+        """One merged Prometheus text exposition over the server, batcher and
+        generator registries (render_prometheus dedupes shared registries and
+        raises on conflicting/duplicate series rather than emitting an
+        invalid scrape)."""
+        regs = [self.registry]
+        if self.batcher is not None:
+            regs.append(self.batcher.metrics.registry)
+        if self.generator is not None:
+            regs.append(self.generator.metrics.registry)
+        return render_prometheus(*regs)
 
     def start(self):
         self._thread.start()

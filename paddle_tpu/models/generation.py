@@ -32,10 +32,12 @@ Models plug in via three hooks:
 from __future__ import annotations
 
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
 
+from ..profiler.profiler import RecordEvent
 from ..tensor import Tensor
 
 
@@ -113,6 +115,24 @@ class GenerationMixin:
         return cache
 
     @staticmethod
+    def _emit_timing(timing_hook, path, B, P, new_tokens, compiled, t0):
+        """Decode timing hook (observability layer): called once per launch
+        with host-wall phase numbers. The decode loop itself is ONE compiled
+        scan — there is no host boundary per token to hook — so the per-step
+        number is launch wall / tokens, which is exactly the figure the
+        serving metrics and the `observability_overhead` bench track. The
+        same interval is also recorded as a profiler RecordEvent (when a
+        Profiler is recording), so serving spans, this hook and profiler
+        step markers all land on one timebase."""
+        if timing_hook is None:
+            return
+        dt = time.perf_counter() - t0
+        timing_hook({"path": path, "batch": int(B), "prompt_len": int(P),
+                     "new_tokens": int(new_tokens), "compiled": bool(compiled),
+                     "launch_s": dt,
+                     "per_token_s": dt / max(1, int(new_tokens))})
+
+    @staticmethod
     def _check_deadline(deadline, where):
         """Deadline gate at the device-launch boundary: the compiled decode
         scan cannot be interrupted mid-flight, so a request whose budget is
@@ -126,7 +146,7 @@ class GenerationMixin:
     # ------------------------------------------------------------ dense path
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
                  eos_token_id=None, seed=0, dtype="bfloat16",
-                 decode_kernel=None, deadline=None):
+                 decode_kernel=None, deadline=None, timing_hook=None):
         """Autoregressive decoding with dense per-layer KV caches.
 
         temperature==0 -> greedy; otherwise softmax sampling with optional
@@ -140,6 +160,9 @@ class GenerationMixin:
         (split-KV flash-decode kernel, ops/pallas/decode_attention.py).
         `deadline`: optional inference.resilience.Deadline — raises
         DeadlineExceeded instead of launching an already-expired decode.
+        `timing_hook`: optional fn(dict) receiving per-launch host timing
+        (launch_s, per_token_s, compiled, ...) — the serving layer feeds the
+        observability metrics/histograms through it.
         """
         ids = (input_ids._value if isinstance(input_ids, Tensor)
                else jnp.asarray(input_ids))
@@ -199,6 +222,7 @@ class GenerationMixin:
                      decode_kernel)
         run_cache = self._runner_cache()
         run = run_cache.get(cache_key)
+        compiled_now = run is None
         if run is None:
             run = run_cache[cache_key] = make_run()
 
@@ -206,7 +230,12 @@ class GenerationMixin:
         self.eval()
         try:
             self._check_deadline(deadline, "dense decode launch")
-            return Tensor(run(state, ids, jax.random.key(seed)))
+            t0 = time.perf_counter()
+            with RecordEvent("generate.dense"):
+                out = Tensor(run(state, ids, jax.random.key(seed)))
+            self._emit_timing(timing_hook, "dense", B, P, max_new_tokens,
+                              compiled_now, t0)
+            return out
         finally:
             if was_training:
                 self.train()
@@ -224,7 +253,7 @@ class GenerationMixin:
     def generate_paged(self, input_ids, prompt_lens, kv_cache, block_tables,
                        max_new_tokens=32, temperature=0.0, top_k=0,
                        eos_token_id=None, seed=0, decode_kernel="pallas",
-                       deadline=None):
+                       deadline=None, timing_hook=None):
         """Autoregressive decoding over a SHARED paged KV pool.
 
         input_ids: [B, P] prompts right-padded to a common P; prompt_lens [B]
@@ -306,6 +335,7 @@ class GenerationMixin:
                      str(ids.dtype), decode_kernel)
         run_cache = self._runner_cache()
         run = run_cache.get(cache_key)
+        compiled_now = run is None
         if run is None:
             run = run_cache[cache_key] = make_run()
 
@@ -313,12 +343,16 @@ class GenerationMixin:
         self.eval()
         try:
             self._check_deadline(deadline, "paged decode launch")
-            toks, new_k, new_v = run(
-                state, ids, jnp.asarray(prompt_lens, jnp.int32),
-                jnp.asarray(block_tables, jnp.int32),
-                tuple(kv_cache.k_pages), tuple(kv_cache.v_pages),
-                jax.random.key(seed))
-            kv_cache.commit(new_k, new_v)
+            t0 = time.perf_counter()
+            with RecordEvent("generate.paged"):
+                toks, new_k, new_v = run(
+                    state, ids, jnp.asarray(prompt_lens, jnp.int32),
+                    jnp.asarray(block_tables, jnp.int32),
+                    tuple(kv_cache.k_pages), tuple(kv_cache.v_pages),
+                    jax.random.key(seed))
+                kv_cache.commit(new_k, new_v)
+            self._emit_timing(timing_hook, "paged", B, P, max_new_tokens,
+                              compiled_now, t0)
             return Tensor(toks)
         finally:
             if was_training:

@@ -1,0 +1,30 @@
+"""paddle_tpu.observability: unified serving observability.
+
+Two halves, one timebase:
+
+* ``trace`` — request-scoped Dapper-style spans (contextvar propagation for
+  single-threaded code, ``RequestTrace`` handles for the cross-thread
+  serving path), ring-buffer storage, chrome-trace export that interleaves
+  with the host profiler's events (``paddle_tpu/profiler``) because both
+  stamp ``time.perf_counter`` microseconds.
+* ``metrics`` — typed Counter/Gauge/Histogram registry with labels and
+  Prometheus text exposition; ``inference.resilience.ServingMetrics`` is
+  re-based on it, and ``InferenceServer`` serves it at
+  ``/metrics?format=prom``.
+
+Span taxonomy, metric names and the scrape/join recipes live in
+docs/OBSERVABILITY.md.
+"""
+from .metrics import (  # noqa: F401
+    DEFAULT_LATENCY_BUCKETS,
+    MetricsRegistry,
+    render_prometheus,
+)
+from .trace import (  # noqa: F401
+    RequestTrace,
+    Span,
+    Tracer,
+    current_trace_id,
+    export_joined_chrome,
+    new_trace_id,
+)

@@ -303,7 +303,12 @@ class Profiler:
             out.extend(snap)
         return out
 
-    def _export_chrome(self, path):
+    def chrome_events(self):
+        """Complete-event ("X") dicts of the collected host events, sorted by
+        start time. Timestamps are ``time.perf_counter`` microseconds — the
+        same timebase paddle_tpu.observability.trace uses, so these merge
+        with serving spans via observability.export_joined_chrome with no
+        clock alignment."""
         trace = []
         for ev in self.events:
             trace.append({
@@ -311,8 +316,12 @@ class Profiler:
                 "ts": ev.start_us, "dur": ev.duration_us,
                 "pid": os.getpid(), "tid": ev.tid,
             })
+        trace.sort(key=lambda e: e["ts"])
+        return trace
+
+    def _export_chrome(self, path):
         with open(path, "w") as f:
-            json.dump({"traceEvents": trace,
+            json.dump({"traceEvents": self.chrome_events(),
                        "displayTimeUnit": "ms"}, f)
         return path
 

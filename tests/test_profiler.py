@@ -27,6 +27,52 @@ def test_make_scheduler_state_sequence():
 def test_make_scheduler_validates():
     with pytest.raises(ValueError):
         make_scheduler(closed=0, ready=0, record=0)
+    with pytest.raises(ValueError):
+        make_scheduler(closed=-1, ready=0, record=1)
+    with pytest.raises(ValueError):
+        make_scheduler(closed=0, ready=-1, record=1)
+
+
+def test_make_scheduler_single_step_record_window():
+    """record=1 means every recording step is also the emit step: the state
+    must be RECORD_AND_RETURN (plain RECORD would never flush a trace)."""
+    S = ProfilerState
+    sched = make_scheduler(closed=0, ready=0, record=1)
+    assert [sched(i) for i in range(4)] == [S.RECORD_AND_RETURN] * 4
+    sched = make_scheduler(closed=2, ready=1, record=1)
+    assert [sched(i) for i in range(8)] == [
+        S.CLOSED, S.CLOSED, S.READY, S.RECORD_AND_RETURN,
+        S.CLOSED, S.CLOSED, S.READY, S.RECORD_AND_RETURN,
+    ]
+
+
+def test_make_scheduler_skip_first_boundary():
+    """Exactly skip_first CLOSED steps, then the cycle starts at its top —
+    the boundary step (step == skip_first) is the first cycle step, not a
+    CLOSED straggler."""
+    S = ProfilerState
+    sched = make_scheduler(closed=0, ready=1, record=1, skip_first=3)
+    assert [sched(i) for i in range(7)] == [
+        S.CLOSED, S.CLOSED, S.CLOSED,              # 0..skip_first-1
+        S.READY, S.RECORD_AND_RETURN,              # first cycle at step 3
+        S.READY, S.RECORD_AND_RETURN,
+    ]
+    assert sched(2) is S.CLOSED and sched(3) is S.READY  # the exact boundary
+
+
+def test_make_scheduler_repeat_boundary_closes_forever():
+    """repeat cycles end exactly at skip_first + repeat*span; every later
+    step is CLOSED (no RECORD window may leak past the budget)."""
+    S = ProfilerState
+    sched = make_scheduler(closed=1, ready=0, record=1, repeat=2,
+                           skip_first=1)
+    span = 2
+    seq = [sched(i) for i in range(1 + 2 * span + 4)]
+    assert seq[:1] == [S.CLOSED]                             # skip_first
+    assert seq[1:1 + 2 * span] == [S.CLOSED, S.RECORD_AND_RETURN] * 2
+    assert seq[1 + 2 * span:] == [S.CLOSED] * 4              # exhausted
+    assert sched(1 + 2 * span - 1) is S.RECORD_AND_RETURN    # last budget step
+    assert sched(1 + 2 * span) is S.CLOSED                   # first over
 
 
 def test_record_event_requires_recording_profiler():
@@ -63,6 +109,39 @@ def test_profiler_tuple_scheduler_and_chrome_export(tmp_path):
     assert all({"name", "ph", "ts", "dur"} <= set(t) for t in trace)
     loaded = profiler.load_profiler_result(p.last_export_path)
     assert len(loaded) == len(trace)
+
+
+def test_chrome_export_golden_structure(tmp_path):
+    """Golden-file contract for the chrome-trace export: valid JSON, every
+    event a COMPLETE "X" event (no unmatched B/E possible by construction)
+    with exactly the golden key set, `ts` monotonic non-decreasing, nesting
+    contained, and the expected (name, cat) population for a known run."""
+    p = Profiler(scheduler=lambda s: ProfilerState.RECORD)
+    p.start()
+    with RecordEvent("outer"):
+        with RecordEvent("inner"):
+            time.sleep(0.002)
+    p.step()
+    p.stop()
+    path = p.export(str(tmp_path / "golden.json"))
+    doc = json.load(open(path))                    # valid JSON
+    assert set(doc) == {"traceEvents", "displayTimeUnit"}
+    assert doc["displayTimeUnit"] == "ms"
+    evs = doc["traceEvents"]
+    for e in evs:
+        assert set(e) == {"name", "ph", "cat", "ts", "dur", "pid", "tid"}
+        assert e["ph"] == "X" and e["dur"] >= 0    # complete events only
+    ts = [e["ts"] for e in evs]
+    assert ts == sorted(ts)                        # monotonic export order
+    golden = sorted([("ProfileStep#0", "ProfileStep"),
+                     ("outer", "PythonUserDefined"),
+                     ("inner", "PythonUserDefined")])
+    assert sorted((e["name"], e["cat"]) for e in evs) == golden
+    by = {e["name"]: e for e in evs}
+    inner, outer = by["inner"], by["outer"]
+    assert outer["ts"] <= inner["ts"]              # containment preserved
+    assert outer["ts"] + outer["dur"] >= inner["ts"] + inner["dur"]
+    assert by["ProfileStep#0"]["ts"] <= outer["ts"]
 
 
 def test_record_event_as_decorator():
