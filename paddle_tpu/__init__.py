@@ -90,9 +90,10 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
         out = net.functional_call(state, Tensor(v))
         return out._value if hasattr(out, "_value") else out
 
+    from .observability.xla import cost_flops
+
     lowered = _j.jit(fwd).lower(state, x._value)
-    cost = lowered.compile().cost_analysis() or {}
-    total = int(cost.get("flops", 0))
+    total = int(cost_flops(lowered.compile()))
     if print_detail:
         print(f"Total Flops: {total}")
     return total

@@ -66,13 +66,32 @@ class ProgBarLogger(Callback):
         self.steps = 0
         self.start = time.time()
 
+    def _monitor_items(self):
+        """Live StepMonitor fields (ips/tokens-per-sec/MFU) when a
+        MonitorCallback bound one to this model; [] otherwise — output is
+        byte-identical to the pre-monitor format when no monitor is active."""
+        mon = getattr(getattr(self, "model", None), "_step_monitor", None)
+        fields = getattr(mon, "last_fields", None) if mon is not None else None
+        if not fields:
+            return []
+        items = []
+        if "ips" in fields:
+            items.append(f"ips: {fields['ips']:.1f}")
+        if "tokens_per_sec" in fields:
+            items.append(f"tok/s: {fields['tokens_per_sec']:.0f}")
+        if "mfu" in fields:
+            items.append(f"mfu: {100.0 * fields['mfu']:.1f}%")
+        return items
+
     def on_batch_end(self, mode, step, logs=None):
         self.steps += 1
         if self.verbose and step % self.log_freq == 0:
-            items = ", ".join(
+            parts = [
                 f"{k}: {np.asarray(v).reshape(-1)[0]:.4f}" if not isinstance(v, str)
                 else f"{k}: {v}" for k, v in (logs or {}).items()
-            )
+            ]
+            parts.extend(self._monitor_items())
+            items = ", ".join(parts)
             ips = self.steps / max(time.time() - self.start, 1e-9)
             print(f"[train] epoch {self.epoch} step {step}: {items} ({ips:.1f} steps/s)")
 
@@ -144,6 +163,100 @@ class EarlyStopping(Callback):
             self.wait += 1
             if self.wait >= self.patience:
                 self.model.stop_training = True
+
+
+class MonitorCallback(Callback):
+    """Binds an ``observability.training.StepMonitor`` to ``Model.fit``.
+
+    The monitor attaches to the model's compiled ``TrainStep`` (created
+    lazily on the first ``train_batch``), so per-step wall time, live MFU,
+    the recompilation sentinel and numerics anomalies all run inside the
+    step itself; this callback contributes the phases only the fit loop can
+    see — ``data_wait`` (loader gap between batches) and ``callbacks``
+    (post-step host work) — on the same trace timeline.
+
+    ``MonitorCallback(log_dir=...)`` opens a ``utils.log_writer.LogWriter``
+    and streams the scalar series (``train/loss``, ``train/ips``,
+    ``train/mfu``, ...) to the VisualDL-role log; pass ``log_writer=`` to
+    share an existing writer, or ``monitor=`` to bring a pre-configured
+    ``StepMonitor``. Extra kwargs go to the ``StepMonitor`` constructor
+    (``samples_per_step=...`` makes the ips gauge live).
+
+    A bound monitor also surfaces through ``ProgBarLogger`` (ips/MFU appear
+    in the step line) via ``model._step_monitor``; with no MonitorCallback
+    in the list, nothing changes anywhere.
+    """
+
+    def __init__(self, monitor=None, log_writer=None, log_dir=None,
+                 **monitor_kwargs):
+        self.monitor = monitor
+        self._log_writer = log_writer
+        self._log_dir = log_dir
+        self._monitor_kwargs = monitor_kwargs
+        self._own_writer = None
+        self._bound = None
+        self._prev_end_us = None
+
+    def on_begin(self, mode, logs=None):
+        if mode != "train":
+            return
+        if self.monitor is None:
+            from ..observability.training import StepMonitor
+
+            writer = self._log_writer
+            if writer is None and self._log_dir:
+                from ..utils.log_writer import LogWriter
+
+                writer = self._own_writer = LogWriter(self._log_dir)
+            self.monitor = StepMonitor(log_writer=writer,
+                                       **self._monitor_kwargs)
+        elif self._log_writer is not None and self.monitor.log_writer is None:
+            self.monitor.log_writer = self._log_writer
+        self.model._step_monitor = self.monitor
+
+    def _try_bind(self):
+        """The TrainStep exists only after prepare()+first use; keep trying
+        until it does (or the model fell back to the eager path)."""
+        model = self.model
+        step = getattr(model, "_train_step", None)
+        if (step is None and getattr(model, "_optimizer", None) is not None
+                and not getattr(model, "_train_step_broken", False)
+                and hasattr(model, "_compiled_step")):
+            try:
+                step = model._compiled_step()
+            except Exception:
+                step = None
+        if step is not None and self._bound is not step:
+            self.monitor.bind(step)
+            self._bound = step
+
+    def on_batch_begin(self, mode, step, logs=None):
+        if mode != "train" or self.monitor is None:
+            return
+        self._try_bind()
+        now = self.monitor.now_us()
+        if self._prev_end_us is not None:
+            self.monitor.record_phase("data_wait", self._prev_end_us, now)
+
+    def on_batch_end(self, mode, step, logs=None):
+        if mode != "train" or self.monitor is None:
+            return
+        self._try_bind()
+        now = self.monitor.now_us()
+        step_end = self.monitor.last_step_end_us
+        if step_end is not None and step_end <= now:
+            self.monitor.record_phase("callbacks", step_end, now)
+        self._prev_end_us = now
+
+    def on_end(self, mode, logs=None):
+        if mode != "train":
+            return
+        if self.monitor is not None and self._bound is not None:
+            self.monitor.detach(self._bound)
+            self._bound = None
+        if self._own_writer is not None:
+            self._own_writer.close()
+            self._own_writer = None
 
 
 class VisualDL(Callback):

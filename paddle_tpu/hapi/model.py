@@ -20,6 +20,8 @@ class Model:
         self._train_step = None       # compiled TrainStep (reference model.py:1098
         self._train_step_broken = False  # runs _run_one_epoch through the
         # prepared Executor program; our analog is the one-XLA-launch TrainStep)
+        self._step_monitor = None     # StepMonitor installed by MonitorCallback;
+        # ProgBarLogger reads its last_fields (ips/MFU) when present
 
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
         self._optimizer = optimizer

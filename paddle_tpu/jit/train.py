@@ -77,6 +77,7 @@ class TrainStep:
         self._jitted = None
         self._compiled = None  # AOT executable installed by aot_prime()
         self._compiled_avals = None  # arg shapes/dtypes the AOT exe was built for
+        self._monitor = None  # observability.training.StepMonitor.bind() target
         self._seed = 0
         # ZeRO stage recipe (dist.shard_optimizer(opt, ShardingStage1/2/3)):
         # enforced as shardings inside the compiled step — state in, grads mid,
@@ -334,9 +335,13 @@ class TrainStep:
         """
         if self._return_outputs:
             raise ValueError("run_steps does not support return_outputs=True")
+        mon = self._monitor
+        t0 = mon.step_begin() if mon is not None else None
         (inner_opt, state, acc_state, step_is, lrs, keys, scan_args,
          const_args, flags) = self._prep_scan_inputs(n_steps, args, stacked,
                                                      advance=True)
+        if mon is not None:
+            mon.before_scan_launch(self, n_steps, flags, args, kwargs, t0)
         losses, new_state, new_acc = self._scanned_for(flags)(
             state, acc_state, step_is, lrs, keys, scan_args, const_args,
             kwargs)
@@ -346,6 +351,8 @@ class TrainStep:
             store = inner_opt._accumulators.setdefault(acc_name, {})
             for k, v in per.items():
                 store[id(self._param_tensors[k])] = v
+        if mon is not None:
+            mon.step_end(self, losses[-1], t0, n_steps=n_steps)
         return Tensor(losses)
 
     def lowered_steps(self, n_steps: int, *args, stacked=False, **kwargs):
@@ -431,13 +438,21 @@ class TrainStep:
         )
 
     def __call__(self, *args, **kwargs):
+        mon = self._monitor
+        t0 = mon.step_begin() if mon is not None else None
         inner_opt, traced = self._prep_inputs(advance=True)
         fn = self._jitted
+        aot_hit = False
         if self._compiled is not None:
             # the AOT executable is shape-specialised; a different batch shape
             # must fall back to the jitted path (which recompiles) not raise
             if self._arg_avals(args, kwargs) == self._compiled_avals:
                 fn = self._compiled
+                aot_hit = True
+        if mon is not None:
+            # h2d span closes + recompile sentinel fingerprints the avals
+            # (catching the aot-fallback recompile right above)
+            mon.before_launch(self, args, kwargs, aot_hit, t0)
         result = fn(*traced, args, kwargs)
         if self._return_outputs:
             loss_val, new_state, new_acc, fwd_outs = result
@@ -450,6 +465,8 @@ class TrainStep:
             store = inner_opt._accumulators.setdefault(acc_name, {})
             for k, v in per.items():
                 store[id(self._param_tensors[k])] = v
+        if mon is not None:
+            mon.step_end(self, loss_val, t0)
         if self._return_outputs:
             outs = jax.tree.map(Tensor, fwd_outs)
             return Tensor(loss_val), outs

@@ -1,6 +1,6 @@
-"""paddle_tpu.observability: unified serving observability.
+"""paddle_tpu.observability: unified serving AND training observability.
 
-Two halves, one timebase:
+Three parts, one timebase:
 
 * ``trace`` — request-scoped Dapper-style spans (contextvar propagation for
   single-threaded code, ``RequestTrace`` handles for the cross-thread
@@ -11,6 +11,13 @@ Two halves, one timebase:
   Prometheus text exposition; ``inference.resilience.ServingMetrics`` is
   re-based on it, and ``InferenceServer`` serves it at
   ``/metrics?format=prom``.
+* ``training`` + ``xla`` — the training-side twin: a ``StepMonitor`` bound
+  to ``jit/train.py:TrainStep`` emits per-step wall/throughput, live MFU
+  from the compiled program's own ``cost_analysis()``, HBM watermarks from
+  ``memory_analysis()``, a recompilation sentinel over argument avals, and
+  typed numerics anomalies — all as ``paddle_train_*`` series on the same
+  registry/tracer primitives (and the same perf_counter timebase, so
+  ``export_joined_chrome`` shows step phases against profiler events).
 
 Span taxonomy, metric names and the scrape/join recipes live in
 docs/OBSERVABILITY.md.
@@ -27,4 +34,14 @@ from .trace import (  # noqa: F401
     current_trace_id,
     export_joined_chrome,
     new_trace_id,
+)
+from .training import (  # noqa: F401
+    AnomalyEvent,
+    NumericsAnomalyDetector,
+    StepMonitor,
+)
+from .xla import (  # noqa: F401
+    cost_flops,
+    device_peak_flops,
+    memory_stats,
 )

@@ -43,12 +43,16 @@ def _escape_label(v: str) -> str:
 
 
 def _fmt(v: float) -> str:
-    """Float -> exposition value: integers render bare (counter idiom)."""
+    """Float -> exposition value: integers render bare (counter idiom).
+    NaN is a legal exposition value (a NaN loss gauge must render, not
+    crash the scrape)."""
     if v == math.inf:
         return "+Inf"
     if v == -math.inf:
         return "-Inf"
     f = float(v)
+    if math.isnan(f):
+        return "NaN"
     return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
 
 

@@ -1,0 +1,107 @@
+"""XLA compiled-program introspection, normalized.
+
+What XLA already knows about a compiled training step is the cheapest
+telemetry there is — it costs nothing at step time because it was computed at
+compile time. This module is the one place that normalizes the two relevant
+surfaces across jax versions and backends:
+
+* ``cost_analysis`` / ``cost_flops`` — the compiled program's own FLOP count
+  (jax returns a dict on some versions, a 1-list of dicts on others; some
+  backends return nothing).  This is the number bench.py's MFU audit and the
+  live ``StepMonitor`` MFU must AGREE on, which is why both now import it
+  from here instead of keeping private copies.
+* ``memory_stats`` — ``compiled.memory_analysis()`` (XLA's
+  ``CompiledMemoryStats``) flattened to plain ints: argument / output / temp /
+  generated-code / alias bytes plus a derived ``peak_bytes`` watermark
+  (arguments + outputs + temps + generated code − aliased), the HBM number a
+  creeping-toward-OOM alert wants.
+* ``device_peak_flops`` — per-chip dense bf16 peak (public TPU specs), the
+  denominator of MFU.  ``None`` off-accelerator so MFU degrades to "absent",
+  never to a made-up number.
+
+Everything here is defensive: an introspection surface a backend does not
+implement yields ``{}`` / ``0.0`` / ``None``, never an exception — telemetry
+must not be able to take down the training loop it watches.
+"""
+from __future__ import annotations
+
+__all__ = ["cost_analysis", "cost_flops", "memory_stats",
+           "device_peak_flops", "PEAK_BF16_FLOPS"]
+
+# Per-chip peak bf16 TFLOP/s (dense), from public TPU specs. The single
+# source of truth — bench.py's _chip_peak reads this table.
+PEAK_BF16_FLOPS = {
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v5": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def device_peak_flops(device) -> float | None:
+    """Dense bf16 peak FLOP/s of `device`, or None when unknown (CPU, new
+    chip revisions): MFU is reported only when the denominator is real."""
+    kind = getattr(device, "device_kind", "") or ""
+    for name, peak in PEAK_BF16_FLOPS.items():
+        if kind.startswith(name):
+            return peak
+    return None
+
+
+def cost_analysis(compiled) -> dict:
+    """`compiled.cost_analysis()` as a plain dict ({} when unavailable)."""
+    try:
+        cost = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    return dict(cost) if cost else {}
+
+
+def cost_flops(compiled) -> float:
+    """FLOPs of one execution of `compiled` per its own cost analysis
+    (0.0 when the backend does not report them)."""
+    try:
+        return float(cost_analysis(compiled).get("flops", 0.0))
+    except Exception:
+        return 0.0
+
+
+_MEM_FIELDS = {
+    "argument": "argument_size_in_bytes",
+    "output": "output_size_in_bytes",
+    "temp": "temp_size_in_bytes",
+    "generated_code": "generated_code_size_in_bytes",
+    "alias": "alias_size_in_bytes",
+}
+
+
+def memory_stats(compiled) -> dict:
+    """`compiled.memory_analysis()` flattened to ints.
+
+    Keys: ``argument_bytes``, ``output_bytes``, ``temp_bytes``,
+    ``generated_code_bytes``, ``alias_bytes`` and the derived watermark
+    ``peak_bytes`` = argument + output + temp + generated_code − alias
+    (aliased donated buffers are counted once). ``{}`` when the backend has
+    no memory analysis."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return {}
+    if ma is None:
+        return {}
+    out = {}
+    for key, attr in _MEM_FIELDS.items():
+        try:
+            out[f"{key}_bytes"] = int(getattr(ma, attr))
+        except Exception:
+            out[f"{key}_bytes"] = 0
+    out["peak_bytes"] = max(0, out["argument_bytes"] + out["output_bytes"]
+                            + out["temp_bytes"] + out["generated_code_bytes"]
+                            - out["alias_bytes"])
+    return out

@@ -3,7 +3,9 @@
 Reference: python/paddle/profiler/timer.py (Benchmark with Event records,
 reader/batch averages, speed summary; hooked from DataLoader and
 Profiler.step). Exponential reset windows from the reference are simplified to
-running windows with explicit reset().
+running windows with explicit reset(). The clock is injectable
+(``Benchmark(clock=...)``) so the averages are unit-testable on a fake clock;
+the default stays ``time.perf_counter`` — the shared observability timebase.
 """
 from __future__ import annotations
 
@@ -40,7 +42,8 @@ class _Avg:
 class Benchmark:
     """Step timing harness. reader cost = time spent waiting on data."""
 
-    def __init__(self):
+    def __init__(self, clock=time.perf_counter):
+        self._clock = clock
         self.reader = _Avg()
         self.batch = _Avg()
         self._step_start = None
@@ -51,13 +54,13 @@ class Benchmark:
     # ---------------------------------------------------------------- lifecycle
     def begin(self):
         self._running = True
-        self._step_start = time.perf_counter()
+        self._step_start = self._clock()
         self._reader_start = self._step_start
 
     def step(self, num_samples=None):
         if not self._running:
             return
-        now = time.perf_counter()
+        now = self._clock()
         self.batch.record(now - self._step_start, num_samples)
         self._step_start = now
         self._reader_start = now
@@ -71,11 +74,11 @@ class Benchmark:
 
     # ---------------------------------------------------------------- reader hooks
     def before_reader(self):
-        self._reader_start = time.perf_counter()
+        self._reader_start = self._clock()
 
     def after_reader(self):
         if self._running and self._reader_start is not None:
-            self.reader.record(time.perf_counter() - self._reader_start)
+            self.reader.record(self._clock() - self._reader_start)
 
     # ---------------------------------------------------------------- results
     @property

@@ -132,6 +132,8 @@ def test_launch_two_process_collective(tmp_path):
     assert r.returncode == 0, (r.stdout, r.stderr, logs)
 
 
+@pytest.mark.slow  # ~35s multi-process restart soak; the happy-path launch
+# legs above keep tier-1 coverage of the same machinery
 def test_launch_restart_on_failure(tmp_path):
     r = _run_launch(["--nproc_per_node", "2", "--max_restarts", "1",
                      "--log_dir", str(tmp_path)], worker_args=("--fail-once",))
@@ -196,6 +198,7 @@ def test_multinode_restart_coordination(tmp_path):
         assert "attempt 1" in log, (node, log)
 
 
+@pytest.mark.slow  # ~35s multi-process soak (see test_launch_restart_on_failure)
 def test_launch_propagates_failure_when_no_restarts(tmp_path):
     r = _run_launch(["--nproc_per_node", "2", "--max_restarts", "0",
                      "--log_dir", str(tmp_path)], worker_args=("--fail-once",))

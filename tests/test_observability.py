@@ -563,6 +563,66 @@ def test_metrics_exposition_lint_and_conservation(small_gpt):
         srv.stop(drain_timeout=5)
 
 
+# ------------------------------------------- training-series exposition lint
+def test_train_series_exposition_lint_with_merged_registries():
+    """ISSUE-4 satellite: the paddle_train_* series hold the same exposition
+    contract as the serving ones — HELP/TYPE for every family, no duplicate
+    series when the training registry is merged with serving registries, and
+    histogram buckets cumulative + +Inf-terminated AS RENDERED."""
+    from paddle_tpu.observability import StepMonitor
+
+    clk = FakeClock()
+    mon = StepMonitor(peak_flops=None, samples_per_step=4, clock=clk,
+                      tracer=Tracer(clock=clk))
+    # three steps at different durations so several buckets fill
+    for dt in (0.003, 0.04, 0.8):
+        t0 = mon.step_begin()
+        clk.tick(dt)
+        mon.step_end(None, 1.0, t0)
+    for i in range(8):
+        mon.observe_scalars(step=i, loss=1.0)
+    mon.observe_scalars(step=9, loss=float("nan"))      # anomaly family
+
+    sm = ServingMetrics(component="generator")
+    sm.inc("accepted")
+    sm.inc("completed")
+    sm.observe_latency(0.02)
+    text = render_prometheus(sm.registry, mon.registry)
+    types, helps, series = _parse_exposition(text)      # no-dup + HELP/TYPE
+
+    for fam, typ in (("paddle_train_steps_total", "counter"),
+                     ("paddle_train_step_seconds", "histogram"),
+                     ("paddle_train_samples_per_sec", "gauge"),
+                     ("paddle_train_mfu", "gauge"),
+                     ("paddle_train_loss", "gauge"),
+                     ("paddle_train_hbm_bytes", "gauge"),
+                     ("paddle_train_recompiles_total", "counter"),
+                     ("paddle_train_anomalies_total", "counter")):
+        assert types.get(fam) == typ, f"{fam} missing/mistyped in exposition"
+        assert helps[fam], f"{fam} rendered without HELP text"
+    assert series[("paddle_train_steps_total", "")] == 3
+    assert series[("paddle_train_anomalies_total", 'kind="nan_loss"')] == 1
+
+    # histogram bucket counts cumulative and +Inf-terminated as rendered
+    buckets = [(labels, v) for (name, labels), v in series.items()
+               if name == "paddle_train_step_seconds_bucket"]
+    assert buckets, "step-seconds histogram rendered no buckets"
+
+    def le_of(labels):
+        mm = re.search(r'le="([^"]+)"', labels)
+        return float(mm.group(1).replace("+Inf", "inf"))
+
+    buckets.sort(key=lambda kv: le_of(kv[0]))
+    counts = [v for _, v in buckets]
+    assert counts == sorted(counts), "bucket counts must be cumulative"
+    assert le_of(buckets[-1][0]) == float("inf"), "missing +Inf bucket"
+    assert counts[-1] == 3
+    assert series[("paddle_train_step_seconds_count", "")] == counts[-1]
+    # the serving side of the merge is intact too
+    assert series[("paddle_serving_events_total",
+                   'component="generator",event="accepted"')] == 1
+
+
 # --------------------------------------------------------------- bench wiring
 def test_observability_overhead_fields():
     import importlib

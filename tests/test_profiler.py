@@ -174,6 +174,65 @@ def test_profile_step_markers_and_summary(capsys):
     assert "Name" in capsys.readouterr().out
 
 
+class _TickClock:
+    """Deterministic clock for Benchmark(clock=...) unit tests."""
+
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def test_avg_records_averages_and_speed():
+    from paddle_tpu.profiler.timer import _Avg
+
+    a = _Avg()
+    assert a.average == 0.0 and a.speed() == 0.0      # empty: no div-by-zero
+    a.record(0.2)
+    a.record(0.4)
+    assert a.average == pytest.approx(0.3)
+    assert a.speed() == pytest.approx(2 / 0.6)        # no samples: steps/sec
+    a.record(0.4, samples=64)
+    assert a.speed() == pytest.approx(64 / 1.0)       # samples recorded: items/sec
+    a.reset()
+    assert a.count == 0 and a.total == 0.0 and a.samples == 0
+    assert a.average == 0.0
+
+
+def test_benchmark_deterministic_on_injected_clock():
+    from paddle_tpu.profiler.timer import Benchmark
+
+    clk = _TickClock()
+    b = Benchmark(clock=clk)
+    b.step()                                          # before begin: no-op
+    assert b.batch.count == 0
+    b.begin()
+    for _ in range(3):
+        b.before_reader()
+        clk.advance(0.010)                            # data wait
+        b.after_reader()
+        clk.advance(0.040)                            # compute
+        b.step(num_samples=32)
+    b.end()
+    assert b.reader_average == pytest.approx(0.010)
+    assert b.batch_average == pytest.approx(0.050)    # reader + compute
+    assert b.ips == pytest.approx(32 * 3 / 0.150)
+    s = b.get_summary()
+    assert s["steps"] == 3 and s["ips"] == b.ips
+    info = b.step_info(unit="images")
+    assert "reader_cost: 0.01000 s" in info
+    assert "batch_cost: 0.05000 s" in info
+    assert "images/s" in info
+    b.step()                                          # after end: no-op
+    assert b.batch.count == 3
+    b.reset()
+    assert b.batch_average == 0.0 and b.reader_average == 0.0
+
+
 def test_benchmark_ips():
     b = profiler.Benchmark()
     b.begin()
