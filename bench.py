@@ -494,6 +494,48 @@ def train_observability_overhead_fields(out):
     return out
 
 
+def bench_graph_lint(on_accel, dev):
+    """Static-analysis leg (ISSUE-5): lint the bundled model zoo programs
+    (GPT/ResNet train steps, dense+paged decode) with paddle_tpu.analysis
+    and report findings-by-rule. The gate is `high_total == 0`: a high
+    finding means a program in THIS repo ships a hazard the linter exists
+    to catch (doubled HBM, f32/f64 matmul leak, host sync in a hot loop).
+    Allowlisted findings are counted separately — suppression is visible,
+    never silent. Same smoke sizes on or off accelerator: lint findings
+    are properties of the traced graph, not the weights."""
+    import time as _time
+
+    from paddle_tpu.analysis.zoo import zoo_reports
+
+    t0 = _time.perf_counter()
+    reports = zoo_reports()
+    out = {
+        "programs": {r.name: r.by_rule() for r in reports},
+        "findings": [f.to_dict() for r in reports for f in r.findings],
+        "suppressed_total": sum(len(r.suppressed) for r in reports),
+        "lint_wall_sec": round(_time.perf_counter() - t0, 3),
+    }
+    graph_lint_fields(out)
+    return out, None
+
+
+def graph_lint_fields(out):
+    """Aggregate + audit fields for the graph_lint section: findings-by-rule
+    across programs, `high_total` and `audit` = ok iff zero high-severity
+    findings. Pure function of the measured dict so tests can pin the
+    wiring on synthetic inputs."""
+    by_rule: dict = {}
+    high = 0
+    for f in out.get("findings", ()):
+        by_rule[f["rule"]] = by_rule.get(f["rule"], 0) + 1
+        if f.get("severity") == "high":
+            high += 1
+    out["findings_by_rule"] = by_rule
+    out["high_total"] = high
+    out["audit"] = "ok" if high == 0 else "lint-high"
+    return out
+
+
 def bench_decode_attention(on_accel, dev):
     """Isolated decode-attention kernel bench: split-KV Pallas vs the XLA
     grouped-einsum path over a dense cache (q = 1 token). Steps are chained
@@ -739,6 +781,15 @@ def main():
     except Exception:
         pass
     try:
+        lint, lint_err = bench_graph_lint(on_accel, dev)
+    except Exception as e:
+        lint, lint_err = None, {"error": repr(e)[:200]}
+    gc.collect()
+    try:
+        jax.clear_caches()
+    except Exception:
+        pass
+    try:
         decode_attn, decode_attn_err = bench_decode_attention(on_accel, dev)
     except Exception as e:
         decode_attn, decode_attn_err = None, {"error": repr(e)[:200]}
@@ -777,6 +828,7 @@ def main():
             "observability_overhead": obs if obs is not None else obs_err,
             "train_observability_overhead": (train_obs if train_obs is not None
                                              else train_obs_err),
+            "graph_lint": lint if lint is not None else lint_err,
             "decode_attention": (decode_attn if decode_attn is not None
                                  else decode_attn_err),
             "long_context": long_ctx if long_ctx is not None else long_ctx_err,

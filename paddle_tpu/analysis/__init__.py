@@ -1,0 +1,40 @@
+"""paddle_tpu.analysis — graph lint: static analysis over traced programs.
+
+Because every training step and decode loop in this framework is ONE traced
+program (ClosedJaxpr → StableHLO), the whole program is inspectable BEFORE
+it runs. This package is the missing correctness-tooling leg next to
+observability: where the PR 4 recompilation sentinel fires after a recompile
+has already cost a step, the linter flags the hazard at trace time.
+
+    from paddle_tpu import analysis
+    report = analysis.analyze(jitted_fn, *example_args)
+    report = analysis.analyze_train_step(step, x, labels=y)
+    for f in report.high():
+        print(f.render())
+
+Rules: donation-miss, dtype-upcast, host-sync, constant-bloat,
+recompile-hazard, collective-axis (catalog: docs/ANALYSIS.md). Gating:
+``python -m paddle_tpu.analysis --self-check`` (CLI over the bundled model
+zoo), the bench ``graph_lint`` leg, and ``StepMonitor(lint=True)`` which
+lints once at first compile and counts findings in
+``paddle_analysis_findings_total{rule,severity}``.
+"""
+from .core import (  # noqa: F401
+    Program,
+    Report,
+    Thresholds,
+    analyze,
+    analyze_jaxpr,
+    analyze_lowered,
+    analyze_train_step,
+)
+from .findings import (  # noqa: F401
+    BUILTIN_ALLOWLIST,
+    HIGH,
+    INFO,
+    WARN,
+    Allowlist,
+    AllowlistEntry,
+    Finding,
+)
+from .rules import RULES  # noqa: F401

@@ -1,0 +1,70 @@
+"""``python -m paddle_tpu.analysis`` — lint the bundled model zoo programs.
+
+Exit status is the gate: 0 when every program is clean at high severity
+(allowlisted findings are printed with their justification, not hidden),
+1 when any un-allowlisted high-severity finding survives. Wire
+``--self-check`` into CI next to the tier-1 tests; ``--json`` emits the
+same findings-by-rule structure the bench ``graph_lint`` leg reports.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="Graph lint over the bundled model zoo programs "
+                    "(GPT/ResNet train steps, dense+paged decode).")
+    parser.add_argument("--self-check", action="store_true",
+                        help="lint the model zoo and exit non-zero on any "
+                             "high-severity finding (the default behavior; "
+                             "the flag exists for explicit CI wiring)")
+    parser.add_argument("--programs", default=None,
+                        help="comma-separated subset of zoo programs "
+                             "(default: all)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit one JSON object instead of text")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    args = parser.parse_args(argv)
+
+    from .rules import RULES
+
+    if args.list_rules:
+        for rule_id, fn in RULES.items():
+            doc = (fn.__doc__ or "").strip().split("\n")[0]
+            print(f"{rule_id:18s} {doc}")
+        return 0
+
+    from .zoo import ZOO_PROGRAMS, zoo_reports
+
+    include = None
+    if args.programs:
+        include = [p.strip() for p in args.programs.split(",") if p.strip()]
+        unknown = [p for p in include if p not in ZOO_PROGRAMS]
+        if unknown:
+            print(f"unknown program(s) {unknown}; available: "
+                  f"{sorted(ZOO_PROGRAMS)}", file=sys.stderr)
+            return 2
+
+    reports = zoo_reports(include=include)
+    high_total = sum(len(r.high()) for r in reports)
+    if args.json:
+        print(json.dumps({
+            "programs": [r.to_dict() for r in reports],
+            "high_total": high_total,
+            "status": "ok" if high_total == 0 else "lint-high",
+        }))
+    else:
+        for r in reports:
+            print(r.render())
+        print(f"-- {len(reports)} program(s), {high_total} high-severity "
+              f"finding(s) -> {'CLEAN' if high_total == 0 else 'FAIL'}")
+    return 0 if high_total == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

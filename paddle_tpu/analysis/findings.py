@@ -1,0 +1,148 @@
+"""Typed findings + allowlist for the graph linter.
+
+A ``Finding`` is one rule violation on one traced program: rule id, severity,
+human message, source provenance (the jaxpr equation's user frame, or the
+argument path for input-level findings) and a remediation hint. Severities:
+
+* ``high`` — will burn a run: doubled HBM (missed donation), halved MXU
+  throughput (f32 matmul in a bf16 block), a host round-trip inside a
+  compiled hot loop, a per-step recompile. Gated: bench/tier-1/CLI
+  ``--self-check`` fail on any high finding that is not allowlisted.
+* ``warn`` — costs something or is fragile (weak-typed scalar captures,
+  mid-sized baked constants) but does not by itself sink a run.
+* ``info`` — context the reader may want; never gated.
+
+An ``Allowlist`` suppresses findings that are INTENTIONAL, with a recorded
+justification — the suppression is visible in ``Report.suppressed`` rather
+than silently dropped, so "clean" always means "clean or explained". Entries
+match on rule id, program-name glob, an optional message/provenance
+substring, and optionally only on specific jax backends (the built-in entry
+for the CPU donation skip in models/generation.py is backend-gated: donation
+is unimplemented on CPU, so the paged decode program legitimately ships
+undonated pools there).
+"""
+from __future__ import annotations
+
+import fnmatch
+
+__all__ = ["HIGH", "WARN", "INFO", "SEVERITIES", "Finding",
+           "AllowlistEntry", "Allowlist", "BUILTIN_ALLOWLIST"]
+
+HIGH = "high"
+WARN = "warn"
+INFO = "info"
+SEVERITIES = (HIGH, WARN, INFO)
+
+
+class Finding:
+    """One rule violation on one analyzed program."""
+
+    __slots__ = ("rule", "severity", "message", "where", "subject",
+                 "remediation")
+
+    def __init__(self, rule, severity, message, *, where="", subject="",
+                 remediation=""):
+        if severity not in SEVERITIES:
+            raise ValueError(f"severity must be one of {SEVERITIES}, "
+                             f"got {severity!r}")
+        self.rule = rule
+        self.severity = severity
+        self.message = message
+        self.where = where            # "file:line (fn)" or an argument path
+        self.subject = subject        # program name (set by the analyzer)
+        self.remediation = remediation
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "severity": self.severity,
+                "message": self.message, "where": self.where,
+                "subject": self.subject, "remediation": self.remediation}
+
+    def __repr__(self):
+        return (f"Finding({self.rule}, {self.severity}, {self.subject!r}, "
+                f"{self.message!r})")
+
+    def render(self) -> str:
+        loc = f" @ {self.where}" if self.where else ""
+        fix = f"\n      fix: {self.remediation}" if self.remediation else ""
+        return (f"[{self.severity.upper():4s}] {self.rule}: "
+                f"{self.message}{loc}{fix}")
+
+
+class AllowlistEntry:
+    """One justified suppression. ``subject`` is a glob over program names;
+    ``contains`` (optional) must appear in the finding's message or
+    provenance; ``backends`` (optional) restricts the entry to specific jax
+    default backends. ``reason`` is mandatory — an allowlist entry without a
+    recorded why is just a weakened rule."""
+
+    __slots__ = ("rule", "subject", "contains", "reason", "backends")
+
+    def __init__(self, rule, subject="*", contains=None, *, reason,
+                 backends=None):
+        if not reason:
+            raise ValueError("allowlist entries require a justification "
+                             "(reason=)")
+        self.rule = rule
+        self.subject = subject
+        self.contains = contains
+        self.reason = reason
+        self.backends = tuple(backends) if backends else None
+
+    def matches(self, finding: Finding, backend: str) -> bool:
+        if self.rule != finding.rule:
+            return False
+        if self.backends is not None and backend not in self.backends:
+            return False
+        if not fnmatch.fnmatch(finding.subject or "", self.subject):
+            return False
+        if self.contains and (self.contains not in finding.message
+                              and self.contains not in finding.where):
+            return False
+        return True
+
+    def __repr__(self):
+        return (f"AllowlistEntry({self.rule}, subject={self.subject!r}, "
+                f"reason={self.reason!r})")
+
+
+class Allowlist:
+    def __init__(self, entries=()):
+        self.entries = list(entries)
+
+    def __iter__(self):
+        return iter(self.entries)
+
+    def extend(self, entries) -> "Allowlist":
+        """A new Allowlist with `entries` appended (builtin stays intact)."""
+        return Allowlist(self.entries + list(entries))
+
+    def apply(self, findings, backend: str):
+        """Split findings into (kept, suppressed) where suppressed is a list
+        of (finding, entry) pairs — suppression is recorded, not silent."""
+        kept, suppressed = [], []
+        for f in findings:
+            entry = next((e for e in self.entries if e.matches(f, backend)),
+                         None)
+            if entry is None:
+                kept.append(f)
+            else:
+                suppressed.append((f, entry))
+        return kept, suppressed
+
+
+# Intentional, justified exceptions shipped with the repo. Keep this list
+# SHORT — every entry is a finding the analyzer is right about but the code
+# is right to keep.
+BUILTIN_ALLOWLIST = Allowlist([
+    # models/generation.py generate_paged: donate_argnums=(4, 5) is applied
+    # only off-CPU because buffer donation is unimplemented on the CPU
+    # backend (jax warns and keeps both copies anyway). On CPU the paged
+    # pools therefore analyze as donation-miss; on TPU they are donated and
+    # the finding disappears — which is exactly the deployment that matters.
+    AllowlistEntry(
+        "donation-miss", subject="*decode*paged*", contains="pages",
+        backends=("cpu",),
+        reason="CPU backend does not implement buffer donation "
+               "(models/generation.py generate_paged donates the KV pools "
+               "on accelerators only; see the donate_argnums backend gate)"),
+])

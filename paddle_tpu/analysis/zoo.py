@@ -1,0 +1,144 @@
+"""The lint model zoo: the repo's own flagship programs, traced and linted.
+
+One place builds the four programs the CLI ``--self-check``, the bench
+``graph_lint`` leg and the tier-1 tests all gate on:
+
+* ``gpt_train``        — GPT smoke ``TrainStep`` (the headline workload)
+* ``resnet_train``     — ResNet-18 smoke ``TrainStep`` (the vision leg)
+* ``gpt_decode_dense`` — ``generate()``'s compiled prefill+scan program
+* ``gpt_decode_paged`` — ``generate_paged()`` over a shared KV pool sized
+  past the donation threshold, so the CPU donation skip
+  (models/generation.py) is actually exercised against the allowlist
+
+Smoke sizes on purpose: lint findings are properties of the GRAPH, not the
+weights, and the same rules fire on a 2-layer 64-wide GPT as on 350M — so
+the gate stays cheap enough for tier-1.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .core import Thresholds, analyze, analyze_train_step
+
+__all__ = ["ZOO_PROGRAMS", "zoo_report", "zoo_reports"]
+
+
+def _gpt_smoke():
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                    num_heads=4, max_position=128)
+    return cfg, GPTForCausalLM(cfg)
+
+
+def gpt_train_report(thresholds=None, allowlist=None):
+    import paddle_tpu as paddle
+    from paddle_tpu.jit.train import TrainStep
+
+    cfg, model = _gpt_smoke()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    step = TrainStep(model, lambda logits, loss: loss, opt)
+    ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 16))
+    x = paddle.to_tensor(ids.astype("int64"))
+    y = paddle.to_tensor(np.roll(ids, -1, axis=1).astype("int64"))
+    return analyze_train_step(step, x, labels=y, name="train_step:GPT",
+                              thresholds=thresholds, allowlist=allowlist)
+
+
+def resnet_train_report(thresholds=None, allowlist=None):
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.jit.train import TrainStep
+
+    paddle.seed(0)
+    model = paddle.vision.models.resnet18(num_classes=10)
+    loss_fn = nn.CrossEntropyLoss()
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=model.parameters())
+    step = TrainStep(model, lambda out, y: loss_fn(out, y), opt)
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(2, 3, 32, 32).astype("float32"))
+    y = paddle.to_tensor(rs.randint(0, 10, 2).astype("int64"))
+    return analyze_train_step(step, x, y, name="train_step:ResNet18",
+                              thresholds=thresholds, allowlist=allowlist)
+
+
+def gpt_decode_dense_report(thresholds=None, allowlist=None):
+    import jax
+
+    import paddle_tpu as paddle
+
+    cfg, model = _gpt_smoke()
+    model.eval()
+    B, P, NEW = 2, 8, 4
+    ids = paddle.to_tensor(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (B, P)).astype("int64"))
+    model.generate(ids, max_new_tokens=NEW)  # builds + caches the runner
+    run = model.compiled_generate_runner(B, P, NEW)
+    import jax.numpy as jnp
+
+    state = model._decode_state(jnp.bfloat16)
+    return analyze(run, state, ids._value, jax.random.key(0),
+                   _name="gpt.decode.dense",
+                   _arg_labels=("state", "prompt", "rng_key"),
+                   _thresholds=thresholds, _allowlist=allowlist)
+
+
+def gpt_decode_paged_report(thresholds=None, allowlist=None):
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.kv_cache import PagedKVCache
+
+    cfg, model = _gpt_smoke()
+    model.eval()
+    B, P, NEW = 2, 8, 4
+    # pool sized past the donation threshold (1 MiB/pool) so the
+    # donation-miss rule actually judges it: on CPU the pools analyze as
+    # non-donated (generation.py's backend gate) and the builtin allowlist
+    # must carry the finding; on TPU they are donated and it vanishes.
+    kv = PagedKVCache(cfg.num_layers, cfg.num_kv_heads,
+                      cfg.hidden_size // cfg.num_heads,
+                      block_size=128, num_blocks=128, dtype="bfloat16")
+    plens = np.full((B,), P, np.int64)
+    for i in range(B):
+        kv.reserve(i, P + NEW)
+    nb = kv.blocks_for(P + NEW)
+    tbl = np.stack([kv.block_table(i, pad_to=nb) for i in range(B)])
+    ids = paddle.to_tensor(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (B, P)).astype("int64"))
+    model.generate_paged(ids, plens, kv, tbl, max_new_tokens=NEW)
+    run = model.compiled_generate_paged_runner(B, P, NEW)
+    return analyze(
+        run, model._decode_state(jnp.bfloat16), ids._value,
+        jnp.asarray(plens, jnp.int32), jnp.asarray(tbl, jnp.int32),
+        tuple(kv.k_pages), tuple(kv.v_pages), jax.random.key(0),
+        _name="gpt.decode.paged",
+        _arg_labels=("state", "prompt", "prompt_lens", "tables",
+                     "k_pages", "v_pages", "rng_key"),
+        _thresholds=thresholds, _allowlist=allowlist)
+
+
+ZOO_PROGRAMS = {
+    "gpt_train": gpt_train_report,
+    "resnet_train": resnet_train_report,
+    "gpt_decode_dense": gpt_decode_dense_report,
+    "gpt_decode_paged": gpt_decode_paged_report,
+}
+
+
+def zoo_report(name, thresholds=None, allowlist=None):
+    return ZOO_PROGRAMS[name](thresholds=thresholds, allowlist=allowlist)
+
+
+def zoo_reports(include=None, thresholds=None, allowlist=None):
+    """Lint the bundled programs; returns a list of Reports. ``include``
+    restricts to a subset of ``ZOO_PROGRAMS`` keys."""
+    names = list(ZOO_PROGRAMS) if include is None else list(include)
+    th = thresholds or Thresholds()
+    return [zoo_report(n, thresholds=th, allowlist=allowlist)
+            for n in names]

@@ -249,6 +249,18 @@ class GenerationMixin:
                 return run
         return None
 
+    def compiled_generate_paged_runner(self, batch, prompt_len,
+                                       max_new_tokens):
+        """The cached compiled paged-decode program
+        (state, prompt, lens, tables, k_pages, v_pages, key) -> toks for a
+        prior generate_paged() shape, or None — the paged twin of
+        compiled_generate_runner (benches and the graph linter analyze the
+        program without re-deriving the cache-key layout)."""
+        for k, run in (getattr(self, "_generate_cache", None) or {}).items():
+            if k[:4] == ("paged", batch, prompt_len, max_new_tokens):
+                return run
+        return None
+
     # ------------------------------------------------------------ paged path
     def generate_paged(self, input_ids, prompt_lens, kv_cache, block_tables,
                        max_new_tokens=32, temperature=0.0, top_k=0,
@@ -286,7 +298,9 @@ class GenerationMixin:
         def make_run():
             # donate the pools on accelerators: XLA aliases them in place so
             # the program never holds two copies of the page pool (donation is
-            # unimplemented on CPU and would only warn there)
+            # unimplemented on CPU and would only warn there — the graph
+            # linter's builtin allowlist carries the resulting CPU
+            # donation-miss finding, see analysis/findings.py)
             try:
                 donate = (4, 5) if jax.default_backend() != "cpu" else ()
             except Exception:

@@ -136,8 +136,14 @@ class StepMonitor:
     def __init__(self, registry=None, tracer=None, *, samples_per_step=None,
                  tokens_per_step=None, peak_flops="auto", flops_per_step=None,
                  detector=None, log_writer=None, log_freq=1, loss_every=1,
-                 enabled=True, clock=time.perf_counter):
+                 lint=True, enabled=True, clock=time.perf_counter):
         self.enabled = bool(enabled)
+        # graph lint at first compile: one extra abstract trace per bound
+        # step (paddle_tpu.analysis), findings counted in
+        # paddle_analysis_findings_total{rule,severity}. lint=False opts out.
+        self.lint = bool(lint)
+        self.lint_report = None
+        self._lint_pending = self.lint
         self.registry = registry if registry is not None else MetricsRegistry()
         self.tracer = tracer if tracer is not None else Tracer(clock=clock)
         self.detector = (detector if detector is not None
@@ -190,6 +196,10 @@ class StepMonitor:
             "paddle_train_anomalies_total",
             "numerics anomalies (NaN/Inf/spike on loss and grad norm)",
             labels=("kind",))
+        self._m_findings = reg.counter(
+            "paddle_analysis_findings_total",
+            "graph-lint findings on the bound step at first compile",
+            labels=("rule", "severity"))
 
     # ------------------------------------------------------------------ time
     def now_us(self) -> float:
@@ -295,6 +305,8 @@ class StepMonitor:
         reason = ("aot_fallback" if (step._compiled is not None
                                      and not aot_hit) else "new_shape")
         self._sentinel(step._arg_avals(args, kwargs), reason, now)
+        if self._lint_pending:
+            self._run_lint(step, args, kwargs)
 
     def before_scan_launch(self, step, n_steps, flags, args, kwargs, t0):
         """run_steps twin of before_launch: the fingerprint also covers the
@@ -309,6 +321,35 @@ class StepMonitor:
                                  "n_steps": n_steps})
         self._sentinel(("scan", n_steps, flags,
                         step._arg_avals(args, kwargs)), "new_shape", now)
+        if self._lint_pending:
+            self._run_lint(step, args, kwargs)
+
+    # ---------------------------------------------------------- graph lint
+    def _run_lint(self, step, args, kwargs):
+        """Lint the bound step ONCE at first compile (the step is about to
+        trace anyway — this is when a donation-miss or dtype-upcast finding
+        is cheapest to surface). One extra abstract trace; findings become
+        ``paddle_analysis_findings_total{rule,severity}`` and a point trace
+        event. Never raises: telemetry must not take down the loop."""
+        self._lint_pending = False
+        now = self.now_us()
+        try:
+            from .. import analysis
+
+            report = analysis.analyze_train_step(step, *args, **kwargs)
+            self.lint_report = report
+            for f in report.findings:
+                self._m_findings.labels(f.rule, f.severity).inc()
+            self.tracer.record(
+                "graph_lint", now, self.now_us(), self._trace_id,
+                tags={"findings": len(report.findings),
+                      "high": len(report.high()),
+                      "suppressed": len(report.suppressed),
+                      "by_rule": repr(report.by_rule())[:200]})
+        except Exception as e:  # pragma: no cover - defensive
+            self.tracer.record("graph_lint", now, self.now_us(),
+                               self._trace_id,
+                               tags={"error": repr(e)[:200]})
 
     def step_end(self, step, loss_val, t0, n_steps=1):
         """Hook 3/3 (state written back): closes the ``step`` span, updates

@@ -23,6 +23,19 @@ __all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad",
            "RAdam", "Rprop"]
 
 
+def _pow_step(base, t):
+    """``base ** t`` for a step counter that may be a TRACED int32 inside a
+    compiled TrainStep. Python-float ** int-array lands in STRONG float64
+    under the framework's global x64, and the f64 scalar then promotes the
+    whole bias-corrected moment math to f64 (slow/emulated on TPU — the
+    graph linter's dtype-upcast rule flags exactly this). Traced counters
+    therefore compute the pow as an f32 scalar (the RAdam idiom); eager
+    Python ints keep exact Python-float math."""
+    if isinstance(t, jax.core.Tracer) or hasattr(t, "dtype"):
+        return jnp.power(jnp.float32(base), jnp.asarray(t, jnp.float32))
+    return base ** t
+
+
 class Optimizer:
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None, multi_precision=False):
@@ -229,7 +242,7 @@ class Adam(Optimizer):
         t = self._step_count
         b1 = self._beta1 if not isinstance(self._beta1, Tensor) else float(self._beta1.item())
         b2 = self._beta2 if not isinstance(self._beta2, Tensor) else float(self._beta2.item())
-        return b1, b2, b1**t, b2**t
+        return b1, b2, _pow_step(b1, t), _pow_step(b2, t)
 
     def _update(self, p, pval, g, lr):
         g = self._apply_decay(p, pval, g)
@@ -307,7 +320,7 @@ class Adamax(Optimizer):
         u = jnp.maximum(self._beta2 * u, jnp.abs(g))
         self._set_acc("moment", p, m)
         self._set_acc("inf_norm", p, u)
-        return pval - lr / (1 - self._beta1**t) * m / (u + self._eps)
+        return pval - lr / (1 - _pow_step(self._beta1, t)) * m / (u + self._eps)
 
 
 class Adagrad(Optimizer):
@@ -394,8 +407,8 @@ class Lamb(Optimizer):
         v = self._beta2 * v + (1 - self._beta2) * jnp.square(g)
         self._set_acc("moment1", p, m)
         self._set_acc("moment2", p, v)
-        mhat = m / (1 - self._beta1**t)
-        vhat = v / (1 - self._beta2**t)
+        mhat = m / (1 - _pow_step(self._beta1, t))
+        vhat = v / (1 - _pow_step(self._beta2, t))
         r = mhat / (jnp.sqrt(vhat) + self._eps)
         wd = self._lamb_wd
         if self._exclude_fn is not None and self._exclude_fn(p):
@@ -568,8 +581,8 @@ class NAdam(Adam):
         g = self._apply_decay(p, pval, g)
         t = self._step_count
         b1, b2 = self._beta1, self._beta2
-        mu_t = b1 * (1 - 0.5 * 0.96 ** (t * self._psi))
-        mu_t1 = b1 * (1 - 0.5 * 0.96 ** ((t + 1) * self._psi))
+        mu_t = b1 * (1 - 0.5 * _pow_step(0.96, t * self._psi))
+        mu_t1 = b1 * (1 - 0.5 * _pow_step(0.96, (t + 1) * self._psi))
         prods = self._acc("mu_prod", p)
         mu_prod = prods * mu_t
         self._set_acc("mu_prod", p, mu_prod)
@@ -581,7 +594,7 @@ class NAdam(Adam):
         self._set_acc("moment2", p, v)
         mhat = (mu_t1 * m / (1 - mu_prod * mu_t1)
                 + (1 - mu_t) * g / (1 - mu_prod))
-        vhat = v / (1 - b2 ** t)
+        vhat = v / (1 - _pow_step(b2, t))
         return pval - lr * mhat / (jnp.sqrt(vhat) + self._eps)
 
 
@@ -600,7 +613,7 @@ class RAdam(Adam):
         v = b2 * v + (1 - b2) * jnp.square(g)
         self._set_acc("moment1", p, m)
         self._set_acc("moment2", p, v)
-        mhat = m / (1 - b1 ** t)
+        mhat = m / (1 - _pow_step(b1, t))
         rho_inf = 2.0 / (1 - b2) - 1.0
         # t may be a traced step counter inside TrainStep: branch via where
         tf = jnp.asarray(t, jnp.float32)

@@ -199,3 +199,66 @@ def test_traced_cond_branch_isolation():
     outer_prims = [str(e.primitive) for e in jaxpr.jaxpr.eqns]
     assert "cond" in outer_prims
     assert "sin" not in outer_prims  # sin only inside the cond branch
+
+
+# ------------------------------------------------ eval_shape probe (ISSUE-5)
+def test_probe_learns_structure_without_executing_ops():
+    """_probe traces the branch with jax.eval_shape: the output treedef and
+    ShapeDtypeStructs come back exact, and no op actually executes (probing
+    a branch that would blow up numerically is safe)."""
+    import jax
+
+    from paddle_tpu.static.nn.control_flow import _probe
+
+    x = paddle.to_tensor(np.ones((2, 3), "float32"))
+
+    def branch():
+        # div-by-zero would poison a real execution; eval_shape never runs it
+        return {"a": x / paddle.zeros_like(x),
+                "b": [x.astype("int32"), paddle.sum(x)]}
+
+    treedef, protos = _probe(branch)
+    assert treedef.num_leaves == 3
+    assert [tuple(p.shape) for p in protos] == [(2, 3), (2, 3), ()]
+    assert [jax.numpy.dtype(p.dtype).name for p in protos] == [
+        "float32", "int32", "float32"]
+
+
+def test_probe_none_branch_structure():
+    from paddle_tpu.static.nn.control_flow import _none_fn, _probe
+
+    treedef, protos = _probe(_none_fn)
+    assert protos == [] and treedef.num_leaves == 0
+
+
+def test_traced_cond_structure_mismatch_raises():
+    @paddle.jit.to_static
+    def f(x):
+        return static_nn.cond(paddle.sum(x) > 0,
+                              lambda: (x, x * 2),      # pair
+                              lambda: x)               # single
+
+    with pytest.raises(ValueError, match="same structure"):
+        f(paddle.to_tensor(np.ones(2, "float32")))
+
+
+def test_traced_cond_dtype_mismatch_raises():
+    @paddle.jit.to_static
+    def f(x):
+        return static_nn.cond(paddle.sum(x) > 0,
+                              lambda: x * 2,                    # float32
+                              lambda: x.astype("int32"))        # int32
+
+    with pytest.raises(ValueError, match="dtype"):
+        f(paddle.to_tensor(np.ones(2, "float32")))
+
+
+def test_traced_switch_case_branch_mismatch_raises():
+    @paddle.jit.to_static
+    def f(idx, x):
+        return static_nn.switch_case(
+            idx, {0: lambda: x, 1: lambda: {"y": x}})
+
+    with pytest.raises(ValueError, match="same structure"):
+        f(paddle.to_tensor(np.array(0, "int32")),
+          paddle.to_tensor(np.ones(2, "float32")))

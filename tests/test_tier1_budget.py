@@ -1,0 +1,58 @@
+"""Tier-1 time-budget guard (ISSUE-5 satellite): the ROADMAP budget rule —
+non-slow tests stay under ~15s each so the 870s driver cap keeps headroom —
+enforced by conftest hooks instead of reviewer memory. These tests pin the
+pure core (duration parsing, threshold + exemption matching) on synthetic
+inputs; the live enforcement rides every full tier-1 session via
+pytest_runtest_logreport/pytest_sessionfinish."""
+import conftest as cf
+
+
+def test_parse_durations_report_extracts_call_lines():
+    text = """
+============================= slowest durations ==============================
+44.00s call     tests/test_vision_models.py::test_param_counts_sane
+2.51s setup    tests/test_foo.py::test_a
+17.24s call     tests/test_elastic.py::test_kill[preempt-True]
+0.90s teardown tests/test_foo.py::test_a
+(2360 durations < 1s hidden.)
+"""
+    d = cf.parse_durations_report(text)
+    assert d == {
+        "tests/test_vision_models.py::test_param_counts_sane": 44.0,
+        "tests/test_elastic.py::test_kill[preempt-True]": 17.24,
+    }
+
+
+def test_budget_violations_threshold_and_exemptions():
+    durations = {
+        "tests/test_a.py::test_fast": 0.2,
+        "tests/test_a.py::test_borderline": 15.0,       # == threshold: ok
+        "tests/test_a.py::test_over": 16.5,
+        "tests/test_b.py::test_param[x-1]": 22.0,
+        "tests/test_b.py::test_param[y-2]": 3.0,
+    }
+    exempt = {"tests/test_b.py::test_param": (22.0, "justified")}
+    got = cf.budget_violations(durations, exempt=exempt, threshold=15.0)
+    # only the non-exempt over-threshold test, worst first
+    assert got == [("tests/test_a.py::test_over", 16.5)]
+    # without the exemption the parametrized case is caught by prefix
+    got = cf.budget_violations(durations, exempt={}, threshold=15.0)
+    assert got == [("tests/test_b.py::test_param[x-1]", 22.0),
+                   ("tests/test_a.py::test_over", 16.5)]
+
+
+def test_budget_exempt_entries_carry_measured_baseline_and_reason():
+    for prefix, (measured, why) in cf.BUDGET_EXEMPT.items():
+        assert prefix.startswith("tests/") and "[" not in prefix
+        assert measured > 10.0       # only genuinely heavy tests belong here
+        assert len(why) > 20         # a justification, not a shrug
+
+
+def test_live_suite_has_no_unexempted_violations():
+    """The guard's own dogfood: everything recorded over-threshold so far in
+    THIS session must be exempt (the list feeds sessionfinish; a failure
+    here names the offender early, with its duration)."""
+    assert cf._budget_violations_seen == [], (
+        "non-slow tests exceeded the tier-1 per-test budget: "
+        f"{cf._budget_violations_seen} — mark them slow or add a justified "
+        "BUDGET_EXEMPT entry")
