@@ -1,6 +1,6 @@
 """The lint model zoo: the repo's own flagship programs, traced and linted.
 
-One place builds the four programs the CLI ``--self-check``, the bench
+One place builds the programs the CLI ``--self-check``, the bench
 ``graph_lint`` leg and the tier-1 tests all gate on:
 
 * ``gpt_train``        — GPT smoke ``TrainStep`` (the headline workload)
@@ -9,6 +9,12 @@ One place builds the four programs the CLI ``--self-check``, the bench
 * ``gpt_decode_paged`` — ``generate_paged()`` over a shared KV pool sized
   past the donation threshold, so the CPU donation skip
   (models/generation.py) is actually exercised against the allowlist
+* ``gpt_prefill_chunk`` / ``gpt_decode_step`` — the continuous scheduler's
+  two fixed-width step programs (inference/scheduler.py): chunked prefill
+  and the slot-masked decode tick. These are the programs a token-level
+  serving loop launches thousands of times per second, so host-sync and
+  recompile-hazard findings here are deploy blockers; their fixed
+  slot/table widths are what keeps them recompile-clean by construction.
 
 Smoke sizes on purpose: lint findings are properties of the GRAPH, not the
 weights, and the same rules fire on a 2-layer 64-wide GPT as on 350M — so
@@ -123,11 +129,86 @@ def gpt_decode_paged_report(thresholds=None, allowlist=None):
         _thresholds=thresholds, _allowlist=allowlist)
 
 
+def _continuous_smoke():
+    """Shared builder for the two continuous-scheduler step programs: a
+    smoke GPT plus a pool sized past the donation threshold (like the paged
+    zoo entry, so the CPU donation allowlist path stays exercised), with one
+    slot live and one idle — the masked-slot configuration the scheduler
+    actually runs."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.kv_cache import PagedKVCache
+
+    cfg, model = _gpt_smoke()
+    model.eval()
+    S, C, NEW, T = 2, 8, 4, 2
+    kv = PagedKVCache(cfg.num_layers, cfg.num_kv_heads,
+                      cfg.hidden_size // cfg.num_heads,
+                      block_size=128, num_blocks=128, dtype="bfloat16")
+    kv.reserve("seq", C + NEW)
+    nb = kv.blocks_for(C + NEW)
+    tbl = np.zeros((S, nb), np.int32)
+    tbl[0] = kv.block_table("seq", pad_to=nb)
+    ids = np.zeros((S, C), np.int64)
+    ids[0] = np.random.RandomState(0).randint(0, cfg.vocab_size, C)
+    return model, kv, tbl, ids, S, C, NEW, T, jnp
+
+
+def gpt_prefill_chunk_report(thresholds=None, allowlist=None):
+    import jax
+
+    from .core import analyze
+
+    model, kv, tbl, ids, S, C, NEW, T, jnp = _continuous_smoke()
+    offs = np.zeros(S, np.int64)
+    lens = np.asarray([C, 0], np.int64)          # slot 1 idle (masked)
+    model.prefill_chunk(ids, offs, lens, kv, tbl)   # builds + caches runner
+    run = model.compiled_prefill_chunk_runner(S, C)
+    return analyze(
+        run, model._decode_state(jnp.bfloat16), jnp.asarray(ids),
+        jnp.asarray(offs, jnp.int32), jnp.asarray(lens, jnp.int32),
+        jnp.asarray(tbl, jnp.int32), tuple(kv.k_pages), tuple(kv.v_pages),
+        jax.random.key(0),
+        _name="gpt.decode.paged_prefill_chunk",
+        _arg_labels=("state", "chunk", "offsets", "chunk_lens", "tables",
+                     "k_pages", "v_pages", "rng_key"),
+        _thresholds=thresholds, _allowlist=allowlist)
+
+
+def gpt_decode_step_report(thresholds=None, allowlist=None):
+    import jax
+
+    from .core import analyze
+
+    model, kv, tbl, ids, S, C, NEW, T, jnp = _continuous_smoke()
+    # prefill the live slot so the step program runs against real state
+    model.prefill_chunk(ids, np.zeros(S, np.int64),
+                        np.asarray([C, 0], np.int64), kv, tbl)
+    tok = np.zeros(S, np.int64)
+    lens = np.asarray([C, 0], np.int64)
+    act = np.asarray([True, False])
+    lmax = np.asarray([C + NEW, 0], np.int64)
+    model.decode_step(tok, lens, act, kv, tbl, steps=T, max_lens=lmax)
+    run = model.compiled_decode_step_runner(S, T)
+    return analyze(
+        run, model._decode_state(jnp.bfloat16), jnp.asarray(tok),
+        jnp.asarray(lens, jnp.int32), jnp.asarray(act),
+        jnp.asarray(lmax, jnp.int32), jnp.asarray(tbl, jnp.int32),
+        tuple(kv.k_pages), tuple(kv.v_pages), jax.random.key(0),
+        _name="gpt.decode.paged_step",
+        _arg_labels=("state", "tokens", "lengths", "active", "max_lens",
+                     "tables", "k_pages", "v_pages", "rng_key"),
+        _thresholds=thresholds, _allowlist=allowlist)
+
+
 ZOO_PROGRAMS = {
     "gpt_train": gpt_train_report,
     "resnet_train": resnet_train_report,
     "gpt_decode_dense": gpt_decode_dense_report,
     "gpt_decode_paged": gpt_decode_paged_report,
+    "gpt_prefill_chunk": gpt_prefill_chunk_report,
+    "gpt_decode_step": gpt_decode_step_report,
 }
 
 

@@ -238,6 +238,25 @@ class PagedKVCache:
         with self._lock:
             return self._requests[request_id].length
 
+    def append_tokens(self, request_id, n: int) -> int:
+        """Incremental append for chunked prefill / per-tick decode: advance
+        the request's live length by `n` rows (monotonic, capacity-checked)
+        and return the new length. set_length() remains the absolute-value
+        form; this is the form a scheduler advancing per tick wants — it can
+        never rewind another tick's progress."""
+        if n < 0:
+            raise ValueError(f"append_tokens: n must be >= 0, got {n}")
+        with self._lock:
+            req = self._requests[request_id]
+            new = req.length + int(n)
+            if new > len(req.blocks) * self.block_size:
+                raise ValueError(
+                    f"length {new} exceeds reserved capacity "
+                    f"{len(req.blocks) * self.block_size}")
+            req.length = new
+            req.touch = next(self._clock)
+            return new
+
     def set_length(self, request_id, n: int):
         with self._lock:
             req = self._requests[request_id]
@@ -274,6 +293,54 @@ class PagedKVCache:
         with self._lock:
             return (self.allocator.in_use - self.evictable_blocks) \
                 / self.num_blocks
+
+    # ----------------------------------------------------------- invariants
+    def check_conservation(self) -> dict:
+        """Ground-truth audit of the allocator + request bookkeeping; raises
+        AssertionError on any violation, returns the recomputed stats.
+
+        Invariants (the ones the continuous scheduler's churn leans on):
+        * no block appears in two live requests' tables (no aliased pages);
+        * the union of request-held blocks == the allocator's live set;
+        * free + in-use partitions the pool exactly;
+        * every request's length fits its reserved capacity;
+        * ``live_utilization`` matches a from-scratch recomputation.
+        Cheap enough to call after every op in the property tests and at the
+        end of chaos storms."""
+        with self._lock:
+            owner: dict[int, object] = {}
+            for rid, req in self._requests.items():
+                for b in req.blocks:
+                    assert 0 <= b < self.num_blocks, \
+                        f"request {rid!r} holds out-of-pool block {b}"
+                    assert b not in owner, \
+                        (f"block {b} shared by {owner[b]!r} and {rid!r}")
+                    owner[b] = rid
+                cap = len(req.blocks) * self.block_size
+                assert req.length <= cap, \
+                    (f"request {rid!r} length {req.length} exceeds "
+                     f"capacity {cap}")
+            live = self.allocator._live
+            assert set(owner) == live, \
+                (f"request-held blocks != allocator live set "
+                 f"(held-not-live={set(owner) - live}, "
+                 f"live-not-held={live - set(owner)})")
+            free = set(self.allocator._free)
+            assert len(free) == len(self.allocator._free), \
+                "free list contains duplicates"
+            assert not (free & live), f"blocks both free and live: {free & live}"
+            assert len(free) + len(live) == self.num_blocks, \
+                (f"free ({len(free)}) + live ({len(live)}) != "
+                 f"pool size {self.num_blocks}")
+            evictable = sum(len(r.blocks) for r in self._requests.values()
+                            if r.done)
+            expect_live_util = (len(live) - evictable) / self.num_blocks
+            n_requests = len(self._requests)
+            got = self.live_utilization
+        assert abs(got - expect_live_util) < 1e-9, \
+            f"live_utilization {got} != ground truth {expect_live_util}"
+        return {"live": len(live), "free": len(free), "evictable": evictable,
+                "requests": n_requests, "live_utilization": got}
 
     # ------------------------------------------------------------ device I/O
     def commit(self, k_pages, v_pages):

@@ -1,0 +1,525 @@
+"""Continuous (in-flight) batching scheduler over the paged KV pool.
+
+Reference role: iteration-level scheduling from Orca (Yu et al., OSDI '22)
+plus the chunked-prefill/decode interleaving of Sarathi-Serve (Agrawal et
+al., OSDI '24), on the substrate PRs 1-3 built: block tables + atomic
+reserve (kv_cache.py), deadline/shed/CAS semantics (resilience.py,
+serving.py) and request-scoped tracing (observability/trace.py).
+
+Shape of the thing — the fixed-batch `GenerateBatchingPredictor` runs one
+compiled program per whole batch: a request arriving mid-cycle waits for the
+next batch, a long prompt stalls every decoder batched with it, and a batch
+is only as fast as its slowest member. `ContinuousGenerateBatchingPredictor`
+replaces the per-batch launch with a persistent TICK loop over a fixed set
+of S slots:
+
+* admit  — each tick, queued requests take free slots by atomically
+  reserving their blocks from the shared pool; a dry pool defers or sheds
+  THAT request only (PR 2 semantics, `CacheOutOfBlocks` never touches
+  batchmates).
+* prefill — prompts are split into fixed-width chunks; each tick spends at
+  most `prefill_token_budget` prompt tokens (across slots) in ONE
+  `prefill_chunk` launch, so a 10k-token prompt never stalls in-flight
+  decoders for more than a chunk's worth of compute (this is what bounds
+  decode p99 — docs/PERF.md).
+* decode — all decoding slots advance `decode_steps` tokens in ONE
+  `decode_step` launch (a compiled scan: the host syncs per tick, not per
+  token).
+* retire — finished / EOS / deadline-expired / client-cancelled sequences
+  free their blocks and slot at the next tick boundary; the freed slot is
+  admissible on the same tick.
+
+Both step programs are FIXED WIDTH (S slots, static chunk width, static
+table width, per-slot active masks), so the scheduler runs exactly two
+compiled programs forever — no shape-driven recompiles as sequences come
+and go (the `recompile-hazard` lint rule gates this by construction;
+analysis/zoo.py registers both programs).
+
+Everything the fixed-batch predictor guaranteed still holds per token-step:
+one Deadline rides HTTP -> queue -> slot and expiry anywhere reaches exactly
+ONE terminal outcome through the request CAS; a dying batcher thread
+releases every slot's blocks and re-enqueues still-pending sequences before
+the supervisor heals it; `close()` fails in-flight sequences with
+ServiceUnavailable instead of stranding clients.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+from .faults import ThreadDeath
+from .kv_cache import CacheOutOfBlocks
+from .resilience import DeadlineExceeded, ServiceUnavailable
+from .serving import _PENDING, GenerateBatchingPredictor
+
+__all__ = ["ContinuousGenerateBatchingPredictor"]
+
+_PREFILL, _DECODE = "prefill", "decode"
+
+
+class _SlotSeq:
+    """One in-flight sequence bound to a scheduler slot."""
+
+    __slots__ = ("req", "rid", "ids", "out_dtype", "plen", "pos", "tok",
+                 "length", "generated", "table", "phase", "max_new", "order")
+
+    def __init__(self, req, rid, ids, out_dtype, max_new, order):
+        self.req = req
+        self.rid = rid
+        self.ids = ids              # int64 prompt (program input dtype)
+        self.out_dtype = out_dtype  # client dtype, restored on finish
+        self.plen = len(ids)
+        self.pos = 0                # prefill progress (tokens in the cache)
+        self.tok = 0                # next decode input (last sampled token)
+        self.length = 0             # cache rows present
+        self.generated: list[int] = []
+        self.table = None           # np.int32 [table_width] page ids
+        self.phase = _PREFILL
+        self.max_new = int(max_new)
+        self.order = order          # admit sequence number (FIFO fairness)
+
+
+class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
+    """Token-level (continuous) scheduler for /generate over the paged pool.
+
+    Knobs (see docs/DEPLOYMENT.md "Continuous batching"):
+
+    max_slots            decode width S: concurrent in-flight sequences.
+    prefill_chunk        static chunk width C — one slot's prefill quantum.
+    prefill_token_budget max prompt tokens spent per tick across all slots
+                         (default 2*C). Lower bounds decode latency under
+                         long-prompt pressure; higher finishes prompts
+                         sooner.
+    decode_steps         tokens each decoding slot advances per tick (one
+                         compiled scan). Higher amortizes dispatch; lower
+                         tightens admit/retire granularity.
+    max_seq_len          static per-sequence capacity (prompt + new tokens);
+                         sets the block-table width of the two compiled
+                         programs. Default: the whole pool for one sequence
+                         (correct but widest table; size it to your real
+                         longest request).
+    max_new_tokens       server-wide output cap; `infer(max_new_tokens=n)`
+                         requests fewer — the sequence retires at n and its
+                         slot is reused immediately (the fixed-batch path
+                         has no equivalent: every batch member decodes the
+                         full cap).
+    eos_token_id         optional early-exit token; on EOS the remainder is
+                         frozen to EOS (sampler parity) and the slot retires.
+    """
+
+    _component = "continuous"
+
+    def __init__(self, model, max_slots=8, prefill_chunk=16,
+                 prefill_token_budget=None, decode_steps=4, max_seq_len=None,
+                 eos_token_id=None, max_defers=32, **kwargs):
+        self.max_slots = int(max_slots)
+        self.prefill_chunk = int(prefill_chunk)
+        self.prefill_token_budget = int(prefill_token_budget
+                                        if prefill_token_budget is not None
+                                        else 2 * self.prefill_chunk)
+        if self.prefill_token_budget < self.prefill_chunk:
+            raise ValueError("prefill_token_budget must cover at least one "
+                             "chunk")
+        self.decode_steps = int(decode_steps)
+        self.eos_token_id = (None if eos_token_id is None
+                             else int(eos_token_id))
+        # slot state exists BEFORE super().__init__ starts the loop thread
+        self._slots: list = [None] * self.max_slots
+        self._slot_lock = threading.Lock()  # gauges scrape from other threads
+        self.max_seq_len = None             # finalized below (needs kv_cache)
+        self.table_width = None
+        super().__init__(model, max_batch_size=max_slots,
+                         max_defers=max_defers, **kwargs)
+        pool_tokens = self.kv_cache.num_blocks * self.kv_cache.block_size
+        self.max_seq_len = int(max_seq_len) if max_seq_len else pool_tokens
+        if self.max_seq_len > pool_tokens:
+            raise ValueError(f"max_seq_len {self.max_seq_len} exceeds the "
+                             f"pool ({pool_tokens} tokens)")
+        self.table_width = self.kv_cache.blocks_for(self.max_seq_len)
+        self._bind_scheduler_metrics()
+
+    # ------------------------------------------------------------- telemetry
+    def _bind_scheduler_metrics(self):
+        reg = self.metrics.registry
+        slots = reg.gauge(
+            "paddle_sched_slots",
+            "Continuous-scheduler slots by phase; "
+            "prefill + decode + free == slot count",
+            labels=("component", "phase"))
+        slots.labels(self._component, _PREFILL).set_function(
+            lambda: self._phase_count(_PREFILL))
+        slots.labels(self._component, _DECODE).set_function(
+            lambda: self._phase_count(_DECODE))
+        slots.labels(self._component, "free").set_function(
+            lambda: self.max_slots - self._phase_count(None))
+        reg.gauge(
+            "paddle_sched_slot_count", "Configured continuous-scheduler "
+            "slot width S", labels=("component",)).labels(
+                self._component).set_function(lambda: self.max_slots)
+        reg.gauge(
+            "paddle_sched_prefill_token_budget",
+            "Max prompt tokens spent per tick across slots (chunked "
+            "prefill knob)", labels=("component",)).labels(
+                self._component).set_function(
+                    lambda: self.prefill_token_budget)
+        reg.gauge(
+            "paddle_sched_prefill_backlog_tokens",
+            "Prompt tokens still to prefill across in-flight slots",
+            labels=("component",)).labels(self._component).set_function(
+                self._prefill_backlog)
+
+    def _gen_timing(self, info):
+        """Launch-latency histogram only: the base hook also counts
+        batch*new_tokens as generated, but a tick's width includes masked
+        idle slots — actual tokens are counted per sequence at retirement
+        (_retire_ok) instead."""
+        self._decode_hist.labels(self._component, info["path"]).observe(
+            info["launch_s"])
+
+    def _phase_count(self, phase):
+        with self._slot_lock:
+            if phase is None:       # live count
+                return sum(1 for s in self._slots if s is not None)
+            return sum(1 for s in self._slots
+                       if s is not None and s.phase == phase)
+
+    def _prefill_backlog(self):
+        with self._slot_lock:
+            return sum(s.plen - s.pos for s in self._slots
+                       if s is not None and s.phase == _PREFILL)
+
+    # ---------------------------------------------------------------- client
+    def infer(self, ids, timeout=None, deadline=None, trace_id=None,
+              max_new_tokens=None):
+        """One prompt in -> prompt + generated ids out.
+
+        `max_new_tokens` (<= the server cap) asks for fewer tokens than the
+        server-wide maximum; the sequence retires the moment it has them and
+        its slot/blocks go to the next request — the aggregate-throughput
+        win whole-request batching cannot give."""
+        req = self._make_request([np.asarray(ids)], timeout, deadline,
+                                 trace_id)
+        if max_new_tokens is not None:
+            req.max_new = max(1, min(int(max_new_tokens),
+                                     self.max_new_tokens))
+        return self._submit(req)
+
+    def _admission_check(self, arrays):
+        plen = len(arrays[0])
+        total = plen + self.max_new_tokens
+        if total > self.max_seq_len:
+            raise ValueError(
+                f"request needs {total} tokens but max_seq_len is "
+                f"{self.max_seq_len}; no retry can succeed")
+        self.model._decode_validate(plen, self.max_new_tokens)
+        need = self.kv_cache.blocks_for(total)
+        self.admission.admit(self._queue.qsize(), cache=self.kv_cache,
+                             blocks_needed=need)
+
+    def pending(self) -> int:
+        """Queued + in-flight sequences (drain condition)."""
+        return self._queue.qsize() + self._phase_count(None)
+
+    # ------------------------------------------------------------- tick loop
+    def _loop(self):
+        if self.fallback_dense:
+            # signature-mismatch degradation: the paged step programs would
+            # scatter garbage; serve through the base collect-and-run loop
+            # (GenerateBatchingPredictor._run_batch -> _run_dense)
+            return super()._loop()
+        try:
+            while not self._stop.is_set():
+                try:
+                    if self._faults is not None:
+                        self._faults.check("batcher.tick")  # ThreadDeath
+                    self._admit()
+                    if self._phase_count(None) == 0:
+                        continue        # _admit parked briefly on the queue
+                    self._busy = True
+                    try:
+                        self._retire_unserviceable()
+                        self._prefill_tick()
+                        self._decode_tick()
+                    finally:
+                        self._busy = False
+                except ThreadDeath:
+                    # the dying thread strands no sequence: blocks go back to
+                    # the pool, pending requests re-enter the queue, and the
+                    # supervisor-healed thread re-runs them from scratch
+                    self._abandon_slots()
+                    raise
+        finally:
+            if self._stop.is_set():
+                self._shutdown_slots()
+
+    def _free_slot(self):
+        with self._slot_lock:
+            for i, s in enumerate(self._slots):
+                if s is None:
+                    return i
+        return None
+
+    def _admit(self):
+        """Fill free slots from the queue (one tick's admissions).
+
+        The reserve is atomic: a request either ends up fully reserved in a
+        slot or the pool is untouched. On a dry pool the request defers or
+        sheds (existing `_shed_or_defer` budget) and admission STOPS for
+        this tick — blocks free as other slots retire, so later ticks
+        retry; already-running slots never notice."""
+        block = self._phase_count(None) == 0    # idle: park, don't spin
+        while True:
+            idx = self._free_slot()
+            if idx is None:
+                return
+            try:
+                req = (self._queue.get(timeout=0.05) if block
+                       else self._queue.get_nowait())
+            except queue.Empty:
+                return
+            block = False
+            if not self._usable(req):
+                continue
+            arr = req.arrays[0]
+            plen = len(arr)
+            max_new = (req.max_new if req.max_new is not None
+                       else self.max_new_tokens)
+            self._rid += 1
+            rid = ("cseq", self._rid)
+            tr = req.trace
+            traced = self.tracer.enabled
+            t_kv = self.tracer.now_us() if traced else 0.0
+            try:
+                self.kv_cache.reserve(rid, plen + max_new)
+            except CacheOutOfBlocks as e:
+                if traced and tr is not None:
+                    tr.child("kv_reserve", t_kv, self.tracer.now_us(),
+                             error=repr(e))
+                self._shed_or_defer(req, e)
+                return
+            if traced and tr is not None:
+                tr.child("kv_reserve", t_kv, self.tracer.now_us(),
+                         blocks=self.kv_cache.blocks_for(plen + max_new))
+            self._end_queue_wait([req])
+            seq = _SlotSeq(req, rid, np.asarray(arr, np.int64), arr.dtype,
+                           max_new, self._rid)
+            seq.table = self.kv_cache.block_table(rid,
+                                                  pad_to=self.table_width)
+            with self._slot_lock:
+                self._slots[idx] = seq
+            self.metrics.inc("admitted_seqs")
+            if tr is not None:
+                tr.event("admitted", slot=idx, prompt_len=plen,
+                         max_new=max_new)
+
+    # ----------------------------------------------------------- retirement
+    def _evict_slot(self, i, s):
+        """Free the slot and return its blocks to the pool (all retirement
+        paths funnel here — blocks can never outlive their sequence)."""
+        with self._slot_lock:
+            if self._slots[i] is s:
+                self._slots[i] = None
+        try:
+            self.kv_cache.mark_done(s.rid)
+            self.kv_cache.release(s.rid)
+        except KeyError:    # pragma: no cover - already evicted/released
+            pass
+
+    def _retire_ok(self, i, s):
+        try:
+            self.kv_cache.set_length(s.rid, s.plen + s.max_new)
+        except (KeyError, ValueError):  # pragma: no cover - audit-only state
+            pass
+        out = np.concatenate(
+            [s.ids, np.asarray(s.generated[:s.max_new], np.int64)])
+        self._finish_req(s.req, out.astype(s.out_dtype))
+        self._evict_slot(i, s)
+        self.metrics.inc("retired_seqs")
+        self._tokens_total.labels(self._component).inc(len(s.generated))
+
+    def _retire_unserviceable(self):
+        """Per token-step deadline/cancel semantics: at every tick boundary a
+        sequence whose client cancelled, or whose deadline expired mid-
+        decode, is retired and its blocks freed — exactly one terminal
+        outcome via the request CAS, batchmates untouched."""
+        for i, s in enumerate(list(self._slots)):
+            if s is None:
+                continue
+            req = s.req
+            if req.state != _PENDING:
+                self.metrics.inc("cancelled_skipped")
+                if req.trace is not None:
+                    req.trace.event("slot_reclaimed_after_cancel", slot=i)
+                self._evict_slot(i, s)
+                self.metrics.inc("retired_seqs")
+                continue
+            if req.deadline is not None and req.deadline.expired():
+                if self._fail(req, DeadlineExceeded(
+                        "deadline expired mid-decode (continuous tick)")):
+                    self.metrics.inc("expired_in_flight")
+                self._evict_slot(i, s)
+                self.metrics.inc("retired_seqs")
+
+    def _absorb(self, i, s, toks) -> bool:
+        """Fold one tick's sampled tokens into the sequence; True if it
+        retired. EOS freezes the remainder (parity with the in-scan
+        sampler's finished mask, which resets per launch)."""
+        eos = self.eos_token_id
+        for t in toks:
+            if len(s.generated) >= s.max_new:
+                break
+            t = int(t)
+            s.generated.append(t)
+            if eos is not None and t == eos:
+                s.generated.extend([eos] * (s.max_new - len(s.generated)))
+                break
+        if len(s.generated) >= s.max_new:
+            self._retire_ok(i, s)
+            return True
+        return False
+
+    def _fail_picks(self, picks, error, span_name, t0):
+        self.breaker.record_failure()
+        self.metrics.inc("batch_failures")
+        reqs = [s.req for _, s in picks]
+        self._span_each(reqs, span_name, t0, self.tracer.now_us(),
+                        error=repr(error))
+        for i, s in picks:
+            self._evict_slot(i, s)
+            self._fail_or_retry(s.req, error)
+
+    # -------------------------------------------------------------- prefill
+    def _prefill_tick(self):
+        with self._slot_lock:
+            pre = [(i, s) for i, s in enumerate(self._slots)
+                   if s is not None and s.phase == _PREFILL]
+        if not pre:
+            return
+        pre.sort(key=lambda t: t[1].order)      # oldest prompt first
+        budget = self.prefill_token_budget
+        picks = []
+        for i, s in pre:
+            if budget < 1:
+                break
+            take = min(self.prefill_chunk, s.plen - s.pos, budget)
+            if take < 1:
+                continue
+            picks.append((i, s, take))
+            budget -= take
+        if not picks:
+            return
+        S, C = self.max_slots, self.prefill_chunk
+        chunk = np.zeros((S, C), np.int64)
+        offs = np.zeros(S, np.int64)
+        lens = np.zeros(S, np.int64)
+        tables = np.zeros((S, self.table_width), np.int32)
+        for i, s, take in picks:
+            chunk[i, :take] = s.ids[s.pos:s.pos + take]
+            offs[i] = s.pos
+            lens[i] = take
+            tables[i] = s.table
+        reqs = [s.req for _, s, _ in picks]
+        traced = self.tracer.enabled
+        t0 = self.tracer.now_us() if traced else 0.0
+        try:
+            if self._faults is not None:
+                self._faults.check("predictor.generate")
+            tk = self.model.prefill_chunk(
+                chunk, offs, lens, self.kv_cache, tables,
+                eos_token_id=self.eos_token_id,
+                decode_kernel=self.decode_kernel,
+                timing_hook=self._gen_timing)
+        except ThreadDeath:
+            raise
+        except Exception as e:
+            self._fail_picks([(i, s) for i, s, _ in picks], e,
+                             "prefill_chunk", t0)
+            return
+        self.breaker.record_success()
+        self.metrics.inc("prefill_ticks")
+        tk = np.asarray(tk._value if hasattr(tk, "_value") else tk)
+        self._span_each(reqs, "prefill_chunk", t0, self.tracer.now_us(),
+                        slots=len(picks),
+                        tokens=int(sum(t for _, _, t in picks)))
+        for i, s, take in picks:
+            s.pos += take
+            s.length = s.pos
+            try:
+                self.kv_cache.append_tokens(s.rid, take)
+            except KeyError:    # pragma: no cover - raced an eviction
+                pass
+            if s.pos >= s.plen:
+                s.phase = _DECODE
+                s.tok = int(tk[i])
+                self._absorb(i, s, [s.tok])
+
+    # --------------------------------------------------------------- decode
+    def _decode_tick(self):
+        with self._slot_lock:
+            dec = [(i, s) for i, s in enumerate(self._slots)
+                   if s is not None and s.phase == _DECODE]
+        if not dec:
+            return
+        S, T = self.max_slots, self.decode_steps
+        tok = np.zeros(S, np.int64)
+        lengths = np.zeros(S, np.int64)
+        maxlens = np.zeros(S, np.int64)
+        active = np.zeros(S, bool)
+        tables = np.zeros((S, self.table_width), np.int32)
+        for i, s in dec:
+            tok[i] = s.tok
+            lengths[i] = s.length
+            maxlens[i] = s.plen + s.max_new   # write ceiling: reserved rows
+            active[i] = True
+            tables[i] = s.table
+        reqs = [s.req for _, s in dec]
+        traced = self.tracer.enabled
+        t0 = self.tracer.now_us() if traced else 0.0
+        try:
+            if self._faults is not None:
+                self._faults.check("predictor.generate")
+            toks = self.model.decode_step(
+                tok, lengths, active, self.kv_cache, tables, steps=T,
+                max_lens=maxlens, eos_token_id=self.eos_token_id,
+                decode_kernel=self.decode_kernel,
+                timing_hook=self._gen_timing)
+        except ThreadDeath:
+            raise
+        except Exception as e:
+            self._fail_picks(dec, e, "decode_step", t0)
+            return
+        self.breaker.record_success()
+        self.metrics.inc("decode_ticks")
+        toks = np.asarray(toks._value if hasattr(toks, "_value") else toks)
+        self._span_each(reqs, "decode_step", t0, self.tracer.now_us(),
+                        slots=len(dec), steps=T)
+        for i, s in dec:
+            s.length += T
+            s.tok = int(toks[i, -1])
+            self._absorb(i, s, toks[i])
+
+    # ------------------------------------------------------------- lifecycle
+    def _abandon_slots(self):
+        """ThreadDeath path: free every slot's blocks; still-pending
+        requests re-enter the queue and re-run from scratch after the
+        supervisor heals the thread (their chunked-prefill progress is
+        lost with the thread — correctness over cleverness)."""
+        for i, s in enumerate(list(self._slots)):
+            if s is None:
+                continue
+            self._evict_slot(i, s)
+            if s.req.state == _PENDING:
+                if s.req.trace is not None:
+                    s.req.trace.event("requeued_after_thread_death")
+                self._enqueue(s.req)
+
+    def _shutdown_slots(self):
+        """stop() path: nobody hangs on a closed scheduler."""
+        for i, s in enumerate(list(self._slots)):
+            if s is None:
+                continue
+            self._fail(s.req, ServiceUnavailable("predictor closed",
+                                                 retry_after=None))
+            self._evict_slot(i, s)

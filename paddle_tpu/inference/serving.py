@@ -45,7 +45,18 @@ from .resilience import (
     Supervisor,
 )
 
-__all__ = ["BatchingPredictor", "GenerateBatchingPredictor", "InferenceServer"]
+__all__ = ["BatchingPredictor", "GenerateBatchingPredictor",
+           "ContinuousGenerateBatchingPredictor", "InferenceServer"]
+
+
+def __getattr__(name):
+    # lazy re-export (PEP 562): scheduler.py subclasses this module's
+    # GenerateBatchingPredictor, so a top-of-module import would be circular
+    if name == "ContinuousGenerateBatchingPredictor":
+        from .scheduler import ContinuousGenerateBatchingPredictor
+
+        return ContinuousGenerateBatchingPredictor
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 _PENDING, _DONE, _CANCELLED = "pending", "done", "cancelled"
 
@@ -59,7 +70,8 @@ class _Request:
     mutually exclusive instead of racy."""
 
     __slots__ = ("arrays", "event", "result", "error", "deadline", "retries",
-                 "defers", "t0", "trace", "enq_us", "_lock", "_state")
+                 "defers", "t0", "trace", "enq_us", "max_new", "_lock",
+                 "_state")
 
     def __init__(self, arrays, deadline=None, trace=None):
         self.arrays = arrays
@@ -72,6 +84,7 @@ class _Request:
         self.t0 = None
         self.trace = trace      # observability.trace.RequestTrace | None
         self.enq_us = None      # queue-entry stamp (tracer µs) of this pass
+        self.max_new = None     # per-request token budget (continuous sched.)
         self._lock = threading.Lock()
         self._state = _PENDING
 

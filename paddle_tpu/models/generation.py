@@ -371,3 +371,227 @@ class GenerationMixin:
         finally:
             if was_training:
                 self.train()
+
+    # --------------------------------------------- continuous-batching steps
+    @staticmethod
+    def _pool_donation():
+        """donate_argnums gate shared by the paged step programs: donation is
+        unimplemented on CPU (jax warns and keeps both copies), so the pools
+        are aliased in place only on accelerators — the graph linter's builtin
+        allowlist carries the resulting CPU donation-miss finding."""
+        try:
+            return jax.default_backend() != "cpu"
+        except Exception:
+            return False
+
+    def prefill_chunk(self, chunk_ids, offsets, chunk_lens, kv_cache,
+                      block_tables, temperature=0.0, top_k=0,
+                      eos_token_id=None, seed=0, decode_kernel="pallas",
+                      timing_hook=None):
+        """One chunked-prefill step over the shared paged pool (fixed width).
+
+        The continuous scheduler (inference/scheduler.py) splits long prompts
+        into fixed-size chunks so prefill interleaves with decode ticks
+        instead of stalling every in-flight decoder. One launch processes up
+        to S slots' current chunks:
+
+        chunk_ids:  [S, C] token chunk per slot, right-padded to the static
+                    chunk width C (zeros in dead positions).
+        offsets:    [S] int — each slot's cache length BEFORE this chunk (the
+                    absolute position of its chunk's first token).
+        chunk_lens: [S] int — valid tokens in each slot's chunk; 0 marks an
+                    idle slot (its writes are dropped, its output ignored).
+        block_tables: [S, NB] page ids (idle slots pad with page 0).
+
+        KV rows for the chunk are scattered at [offset, offset+len) through
+        the out-of-bounds-drop trick, exactly like generate_paged's prefill;
+        attention masks cols <= offset + row so chunk N attends to chunks
+        0..N-1 plus its own causal prefix. Returns [S] next-token samples
+        from each chunk's LAST valid position — meaningful only for the slot
+        whose chunk completes its prompt (the scheduler ignores the rest).
+        Pools are committed back to `kv_cache`."""
+        ids = (chunk_ids._value if isinstance(chunk_ids, Tensor)
+               else jnp.asarray(chunk_ids))
+        S, C = ids.shape
+        decode_dtype = (jnp.dtype(kv_cache.dtype)
+                        if kv_cache.dtype != jnp.float32 else None)
+        state = self._decode_state(decode_dtype)
+        ids_dtype = ids.dtype
+        greedy = not (temperature and temperature > 0)
+        eos = -1 if eos_token_id is None else int(eos_token_id)
+        sample = self._make_sampler(greedy, temperature, top_k, eos, ids_dtype)
+        NB = int(block_tables.shape[1])
+
+        def make_run():
+            donate = (5, 6) if self._pool_donation() else ()
+
+            @functools.partial(jax.jit, donate_argnums=donate)
+            def run(raw_state, chunk, offs, lens, tables, k_pages, v_pages,
+                    key):
+                offs = offs.astype(jnp.int32)
+                lens = lens.astype(jnp.int32)
+                caches = list(zip(k_pages, v_pages))
+                valid = (jnp.arange(C, dtype=jnp.int32)[None, :]
+                         < lens[:, None])
+                logits, caches = self._decode_call(
+                    raw_state, chunk, caches, offs, decode_kernel,
+                    paged_tables=tables, cache_valid=valid)
+                last = jnp.take_along_axis(
+                    logits,
+                    jnp.maximum(lens - 1, 0)[:, None, None].astype(jnp.int32),
+                    axis=1)[:, 0]
+                tok, _, _ = sample(last, key, jnp.zeros((S,), bool))
+                return (tok, [kc for kc, _ in caches],
+                        [vc for _, vc in caches])
+
+            return run
+
+        cache_key = ("prefill_chunk", S, C, NB, kv_cache.signature(), greedy,
+                     float(temperature or 0.0), int(top_k or 0), eos,
+                     str(ids_dtype), decode_kernel)
+        run_cache = self._runner_cache()
+        run = run_cache.get(cache_key)
+        compiled_now = run is None
+        if run is None:
+            run = run_cache[cache_key] = make_run()
+
+        was_training = self.training
+        self.eval()
+        try:
+            t0 = time.perf_counter()
+            with RecordEvent("generate.prefill_chunk"):
+                tok, new_k, new_v = run(
+                    state, ids, jnp.asarray(offsets, jnp.int32),
+                    jnp.asarray(chunk_lens, jnp.int32),
+                    jnp.asarray(block_tables, jnp.int32),
+                    tuple(kv_cache.k_pages), tuple(kv_cache.v_pages),
+                    jax.random.key(seed))
+                kv_cache.commit(new_k, new_v)
+            self._emit_timing(timing_hook, "prefill_chunk", S, C, 0,
+                              compiled_now, t0)
+            return Tensor(tok)
+        finally:
+            if was_training:
+                self.train()
+
+    def decode_step(self, tokens, lengths, active, kv_cache, block_tables,
+                    steps=1, max_lens=None, temperature=0.0, top_k=0,
+                    eos_token_id=None, seed=0, decode_kernel="pallas",
+                    timing_hook=None):
+        """`steps` decode iterations for a fixed-width slot batch (one tick).
+
+        The continuous scheduler's steady-state program: S slots, each either
+        an in-flight sequence or idle. Per scan iteration every ACTIVE slot
+        writes its current token's KV at `lengths` and samples the next
+        token; idle slots are fully masked (writes dropped via the cache
+        valid mask, outputs held) so one compiled program serves every
+        admit/retire configuration — no recompiles as sequences come and go.
+
+        tokens:  [S] current input token per slot (last sampled, not yet in
+                 the cache — same convention as generate_paged's scan body).
+        lengths: [S] int — cache rows present per slot; advances by 1 per
+                 step for active slots only.
+        active:  [S] bool slot mask.
+        block_tables: [S, NB] page ids (idle slots pad with page 0).
+        max_lens: [S] int — per-slot KV write ceiling. The tick runs a FIXED
+                 `steps` iterations, so a sequence retiring mid-tick would
+                 otherwise keep writing past its reserved blocks and scatter
+                 into the table's pad page (page 0 belongs to someone else);
+                 writes at positions >= max_lens are dropped instead. None
+                 means no ceiling (every step may write).
+
+        Returns [S, steps] sampled tokens (idle slots repeat their input).
+        Pools are committed back to `kv_cache`. The host syncs once per tick,
+        not per token — `steps` amortizes dispatch exactly like the
+        generate() scan does."""
+        tokens = (tokens._value if isinstance(tokens, Tensor)
+                  else jnp.asarray(tokens))
+        S = int(tokens.shape[0])
+        T = int(steps)
+        decode_dtype = (jnp.dtype(kv_cache.dtype)
+                        if kv_cache.dtype != jnp.float32 else None)
+        state = self._decode_state(decode_dtype)
+        ids_dtype = tokens.dtype
+        greedy = not (temperature and temperature > 0)
+        eos = -1 if eos_token_id is None else int(eos_token_id)
+        sample = self._make_sampler(greedy, temperature, top_k, eos, ids_dtype)
+        NB = int(block_tables.shape[1])
+        if max_lens is None:    # no ceiling: same program, permissive values
+            max_lens = jnp.asarray(lengths, jnp.int32) + jnp.int32(T)
+
+        def make_run():
+            donate = (6, 7) if self._pool_donation() else ()
+
+            @functools.partial(jax.jit, donate_argnums=donate)
+            def run(raw_state, tok, lens, act, lmax, tables, k_pages, v_pages,
+                    key):
+                lens = lens.astype(jnp.int32)
+                lmax = lmax.astype(jnp.int32)
+                caches = list(zip(k_pages, v_pages))
+                adv = act.astype(jnp.int32)
+
+                def body(carry, _):
+                    tok, caches, lens, key, finished = carry
+                    valid = (act & (lens < lmax))[:, None]
+                    lg, caches = self._decode_call(
+                        raw_state, tok[:, None], caches, lens, decode_kernel,
+                        paged_tables=tables, cache_valid=valid)
+                    nxt, key, finished = sample(lg[:, -1], key, finished)
+                    nxt = jnp.where(act, nxt, tok)   # idle slots hold
+                    return (nxt, caches, lens + adv, key, finished), nxt
+
+                (_, caches, _, _, _), toks = jax.lax.scan(
+                    body, (tok, caches, lens, key, jnp.zeros((S,), bool)),
+                    jnp.arange(T))
+                return (jnp.swapaxes(toks, 0, 1),
+                        [kc for kc, _ in caches], [vc for _, vc in caches])
+
+            return run
+
+        cache_key = ("decode_step", S, T, NB, kv_cache.signature(), greedy,
+                     float(temperature or 0.0), int(top_k or 0), eos,
+                     str(ids_dtype), decode_kernel)
+        run_cache = self._runner_cache()
+        run = run_cache.get(cache_key)
+        compiled_now = run is None
+        if run is None:
+            run = run_cache[cache_key] = make_run()
+
+        was_training = self.training
+        self.eval()
+        try:
+            t0 = time.perf_counter()
+            with RecordEvent("generate.decode_step"):
+                toks, new_k, new_v = run(
+                    state, tokens, jnp.asarray(lengths, jnp.int32),
+                    jnp.asarray(active, bool),
+                    jnp.asarray(max_lens, jnp.int32),
+                    jnp.asarray(block_tables, jnp.int32),
+                    tuple(kv_cache.k_pages), tuple(kv_cache.v_pages),
+                    jax.random.key(seed))
+                kv_cache.commit(new_k, new_v)
+            self._emit_timing(timing_hook, "decode_step", S, 1, T,
+                              compiled_now, t0)
+            return Tensor(toks)
+        finally:
+            if was_training:
+                self.train()
+
+    def compiled_prefill_chunk_runner(self, slots, chunk):
+        """The cached compiled prefill-chunk program
+        (state, chunk, offsets, lens, tables, k_pages, v_pages, key) -> tok
+        for a prior prefill_chunk() shape, or None (zoo lint + bench audit
+        hook, the chunked twin of compiled_generate_paged_runner)."""
+        for k, run in (getattr(self, "_generate_cache", None) or {}).items():
+            if k[:3] == ("prefill_chunk", slots, chunk):
+                return run
+        return None
+
+    def compiled_decode_step_runner(self, slots, steps):
+        """The cached compiled decode-step program
+        (state, tok, lens, active, tables, k_pages, v_pages, key) -> toks
+        for a prior decode_step() shape, or None."""
+        for k, run in (getattr(self, "_generate_cache", None) or {}).items():
+            if k[:3] == ("decode_step", slots, steps):
+                return run
+        return None

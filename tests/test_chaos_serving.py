@@ -576,3 +576,173 @@ def test_server_maps_timeout_to_504(small_gpt):
         assert status == 504
     finally:
         srv.stop(drain_timeout=5)
+
+
+# ------------------------------------------- continuous-scheduler chaos legs
+def _continuous(m, faults=None, **kw):
+    from paddle_tpu.inference.scheduler import (
+        ContinuousGenerateBatchingPredictor,
+    )
+
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("prefill_chunk", 4)
+    kw.setdefault("decode_steps", 2)
+    kw.setdefault("max_new_tokens", 3)
+    kw.setdefault("decode_kernel", "xla")
+    kw.setdefault("block_size", 8)
+    kw.setdefault("num_blocks", 16)
+    kw.setdefault("max_seq_len", 16)
+    return ContinuousGenerateBatchingPredictor(m, faults=faults, **kw)
+
+
+def test_continuous_injected_reserve_oom_defers_and_completes(small_gpt):
+    """kv.reserve OOM mid-stream: the admit defers THAT request a tick and
+    completes it when blocks free — batchmates in other slots never see the
+    fault and the pool comes back conserved."""
+    m, prompt, ref = small_gpt
+    f = FaultInjector()
+    gp = _continuous(m, faults=f)
+    try:
+        f.install("kv.reserve", error=CacheOutOfBlocks("injected pool-dry"),
+                  times=1)
+        out = gp.infer(prompt, timeout=120)
+        np.testing.assert_array_equal(out, ref)
+        assert gp.metrics.get("deferred") == 1
+        assert gp.kv_cache.blocks_in_use == 0
+        gp.kv_cache.check_conservation()
+    finally:
+        gp.close()
+
+
+def test_continuous_reserve_oom_sheds_429_after_defer_budget(small_gpt):
+    m, prompt, _ = small_gpt
+    f = FaultInjector()
+    gp = _continuous(m, faults=f, max_defers=0)
+    try:
+        f.install("kv.reserve", error=CacheOutOfBlocks("injected pool-dry"),
+                  times=1)
+        with pytest.raises(ServerBusy) as ei:
+            gp.infer(prompt, timeout=120)
+        assert ei.value.status == 429 and ei.value.retry_after is not None
+        assert gp.metrics.get("shed_busy") == 1
+        assert gp.kv_cache.blocks_in_use == 0
+    finally:
+        gp.close()
+
+
+def test_continuous_batcher_thread_death_heals_and_strands_no_sequence(
+        small_gpt):
+    """Thread death mid-decode: the dying tick loop releases every slot's
+    blocks and re-enqueues still-pending sequences; the supervisor-healed
+    thread re-runs them from scratch to the same tokens."""
+    m, prompt, ref = small_gpt
+    f = FaultInjector()
+    gp = _continuous(m, faults=f)
+    try:
+        # one death mid-stream (after the first predictor launch), one at
+        # the tick top
+        f.install("predictor.generate", error=ThreadDeath(), after=1,
+                  times=1)
+        out = gp.infer(prompt, timeout=120)
+        np.testing.assert_array_equal(out, ref)
+        f.install("batcher.tick", error=ThreadDeath(), times=1)
+        deadline = time.monotonic() + 5
+        while gp._sup.alive() and time.monotonic() < deadline:
+            time.sleep(0.005)
+        out = gp.infer(prompt, timeout=120)
+        np.testing.assert_array_equal(out, ref)
+        assert gp._sup.restarts >= 2
+        assert gp.kv_cache.blocks_in_use == 0
+        gp.kv_cache.check_conservation()
+        assert _drain_outcomes(gp.metrics) == gp.metrics.get("accepted") == 2
+    finally:
+        gp.close()
+
+
+def test_continuous_clock_skew_expires_deadline_mid_decode(small_gpt):
+    """Deadline semantics per token-step: skew the injected clock while a
+    sequence decodes; the next tick retires it with ONE DeadlineExceeded,
+    frees its blocks, and keeps serving."""
+    m, prompt, ref = small_gpt
+    f = FaultInjector()
+    gp = _continuous(m, faults=f, max_new_tokens=3)
+    try:
+        gp.infer(prompt, timeout=120)          # warm both step programs
+        f.install("predictor.generate", delay=0.25, after=1, times=1)
+        err = {}
+
+        def victim():
+            try:
+                gp.infer(prompt, timeout=60)   # nominally a minute
+            except TimeoutError as e:
+                err["e"] = e
+
+        v = threading.Thread(target=victim)
+        v.start()
+        time.sleep(0.1)
+        f.skew_clock(120.0)                    # a "2 minute" stall
+        v.join(timeout=30)
+        assert not v.is_alive()
+        assert isinstance(err.get("e"), TimeoutError), err
+        deadline = time.monotonic() + 30
+        while gp.pending() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert gp.kv_cache.blocks_in_use == 0
+        gp.kv_cache.check_conservation()
+        out = gp.infer(prompt, timeout=120)    # still serving afterwards
+        np.testing.assert_array_equal(out, ref)
+        m_ = gp.metrics
+        assert m_.get("timeouts") == 1
+        assert _drain_outcomes(m_) == m_.get("accepted") == 3
+    finally:
+        gp.close()
+
+
+def test_continuous_storm_exactly_one_terminal_and_pool_conserved(small_gpt):
+    """Continuous-scheduler storm: injected pool-dry + a predictor crash +
+    a thread death across concurrent clients with a tight-deadline minority
+    — every client sees exactly one outcome, terminal counters conserve,
+    and the KV pool passes the ground-truth conservation audit."""
+    m, prompt, ref = small_gpt
+    f = FaultInjector()
+    gp = _continuous(m, faults=f, max_slots=2, num_blocks=8, max_retries=1,
+                     max_defers=64)
+    try:
+        f.install("kv.reserve", error=CacheOutOfBlocks("injected"), after=1,
+                  times=1)
+        f.install("predictor.generate", error=RuntimeError("injected"),
+                  after=2, times=1)
+        f.install("predictor.generate", error=ThreadDeath(), after=5,
+                  times=1)
+        N = 6
+        outcomes = [[] for _ in range(N)]
+
+        def client(i):
+            try:
+                outcomes[i].append(
+                    ("ok", gp.infer(prompt,
+                                    timeout=(0.25 if i == 3 else 300))))
+            except TimeoutError:
+                outcomes[i].append(("timeout",))
+            except Rejected:
+                outcomes[i].append(("shed",))
+            except Exception as e:   # noqa: BLE001 - storm bookkeeping
+                outcomes[i].append(("fail", e))
+
+        ts = [threading.Thread(target=client, args=(i,)) for i in range(N)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=300)
+        assert not any(t.is_alive() for t in ts), "a client deadlocked"
+        assert all(len(o) == 1 for o in outcomes), "non-exactly-once outcome"
+        for o in outcomes:
+            if o[0][0] == "ok":
+                np.testing.assert_array_equal(o[0][1], ref)
+        assert gp.metrics.get("accepted") == _drain_outcomes(gp.metrics)
+        assert gp.kv_cache.blocks_in_use == 0
+        gp.kv_cache.check_conservation()
+        out = gp.infer(prompt, timeout=120)    # alive after the storm
+        np.testing.assert_array_equal(out, ref)
+    finally:
+        gp.close()

@@ -244,6 +244,23 @@ def test_paged_decode_clean_with_visible_cpu_donation_allowlist(zoo_reports):
     assert all("CPU backend" in e.reason for _, e in sup)
 
 
+@pytest.mark.parametrize("name", ["gpt.decode.paged_prefill_chunk",
+                                  "gpt.decode.paged_step"])
+def test_continuous_step_programs_lint_clean(zoo_reports, name):
+    """ISSUE-6 satellite: the continuous scheduler's two fixed-width step
+    programs (prefill_chunk / decode_step) are in the zoo and lint clean —
+    no host sync inside the tick scan, no recompile hazard from the
+    slot-masked design, and the same CPU-only donation suppression as the
+    other paged program (pools donated off-CPU)."""
+    r = zoo_reports[name]
+    assert r.high() == []
+    sup = [f for f, _ in r.suppressed if f.rule == "donation-miss"]
+    assert len(sup) == 4                      # k+v pools x 2 layers
+    kept_rules = {f.rule for f in r.findings}
+    assert "host-sync" not in kept_rules
+    assert "recompile-hazard" not in kept_rules
+
+
 def test_train_step_donation_rule_would_catch_dropped_donation():
     """Prove the donation rule actually guards TrainStep: the same GPT step
     program analyzed with donation stripped (tightened threshold so the

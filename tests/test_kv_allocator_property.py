@@ -1,0 +1,127 @@
+"""KV allocator churn property test (ISSUE-6 satellite): randomized
+admit / append / retain / release / evict sequences over the free-list
+allocator, with PagedKVCache.check_conservation() asserting after EVERY op
+that blocks are conserved, no block is shared across live sequences, and
+live_utilization matches a from-scratch recomputation.
+
+This is the host-side invariant the continuous scheduler's per-tick churn
+(admit + retire every tick, eviction under pressure) leans on; a bookkeeping
+bug that only bites after a specific interleaving shows up here as a seeded,
+replayable failure instead of a flaky chaos run.
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu.inference.kv_cache import (
+    BlockAllocator,
+    CacheOutOfBlocks,
+    PagedKVCache,
+)
+
+
+def _mk_cache(num_blocks=24, block_size=4):
+    # tiny geometry: every few ops cross a block boundary or dry the pool
+    return PagedKVCache(num_layers=1, num_kv_heads=1, head_dim=2,
+                        block_size=block_size, num_blocks=num_blocks,
+                        dtype="float32")
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_randomized_churn_conserves_pool(seed):
+    rng = np.random.default_rng(seed)
+    kv = _mk_cache()
+    live: dict = {}       # rid -> reserved token capacity
+    done: set = set()
+    next_rid = 0
+    stats = {"reserve": 0, "oom": 0, "append": 0, "release": 0, "done": 0}
+    for _ in range(400):
+        op = rng.choice(["reserve", "append", "mark_done", "release",
+                         "reserve_big"])
+        if op in ("reserve", "reserve_big"):
+            want = int(rng.integers(1, 40 if op == "reserve_big" else 12))
+            rid = f"r{next_rid}"
+            try:
+                kv.reserve(rid, want)
+                live[rid] = want
+                next_rid += 1
+                stats["reserve"] += 1
+                # eviction may have reclaimed done-but-retained requests
+                for gone in set(live) - set(kv._requests):
+                    del live[gone]
+                    done.discard(gone)
+            except CacheOutOfBlocks:
+                stats["oom"] += 1
+        elif op == "append" and live:
+            rid = str(rng.choice(sorted(live)))
+            room = (kv.blocks_for(live[rid]) * kv.block_size
+                    - kv.length(rid))
+            if room > 0:
+                kv.append_tokens(rid, int(rng.integers(0, room + 1)))
+                stats["append"] += 1
+            else:
+                with pytest.raises(ValueError):
+                    kv.append_tokens(rid, 1)
+        elif op == "mark_done" and live:
+            rid = str(rng.choice(sorted(live)))
+            if rid not in done:
+                kv.mark_done(rid)
+                done.add(rid)
+                stats["done"] += 1
+        elif op == "release" and live:
+            rid = str(rng.choice(sorted(live)))
+            if rid in kv._requests:
+                kv.release(rid)
+            del live[rid]
+            done.discard(rid)
+            stats["release"] += 1
+        kv.check_conservation()      # the property: holds after EVERY op
+    # drain everything; the pool must come back whole
+    for rid in list(live):
+        if rid in kv._requests:
+            kv.release(rid)
+    info = kv.check_conservation()
+    assert info["live"] == 0 and info["free"] == kv.num_blocks
+    assert stats["reserve"] > 20, f"degenerate run: {stats}"
+
+
+def test_reserve_is_atomic_under_eviction_shortfall():
+    """The old evict-then-fail bug class: when eviction STILL cannot cover
+    the allocation, nothing may have been evicted."""
+    kv = _mk_cache(num_blocks=8, block_size=4)
+    kv.reserve("live", 16)           # 4 blocks, still decoding
+    kv.reserve("ret", 8)             # 2 blocks, finished-but-retained
+    kv.mark_done("ret")
+    with pytest.raises(CacheOutOfBlocks):
+        kv.reserve("big", 32)        # needs 8 > 2 free + 2 evictable
+    assert "ret" in kv._requests     # retained cache survived the failure
+    kv.check_conservation()
+    kv.reserve("fits", 12)           # 3 blocks: evicts "ret" and succeeds
+    assert "ret" not in kv._requests
+    kv.check_conservation()
+
+
+def test_allocator_lifo_reuse_and_double_free_guard():
+    a = BlockAllocator(8)
+    first = a.allocate(3)
+    a.free(first)
+    again = a.allocate(3)
+    assert again[0] == first[-1]     # hottest (most recently freed) first
+    with pytest.raises(ValueError):
+        a.free([99])                 # outside the pool
+    a.free(again)
+    with pytest.raises(ValueError):
+        a.free(again)                # double free
+    assert a.available == 8 and a.in_use == 0
+
+
+def test_append_tokens_monotonic_and_capacity_checked():
+    kv = _mk_cache(num_blocks=4, block_size=4)
+    kv.reserve("r", 10)              # 3 blocks -> 12 rows capacity
+    assert kv.append_tokens("r", 5) == 5
+    assert kv.append_tokens("r", 7) == 12
+    with pytest.raises(ValueError):
+        kv.append_tokens("r", 1)     # past reserved capacity
+    with pytest.raises(ValueError):
+        kv.append_tokens("r", -1)    # never rewinds
+    assert kv.length("r") == 12
+    kv.check_conservation()

@@ -98,6 +98,49 @@ def test_pressure_bench_wires_conservation_fields():
     assert "metrics.snapshot()" in src
 
 
+def test_continuous_fields_speedup_and_gate():
+    """ISSUE-6 acceptance wiring: the continuous_serving section derives
+    `speedup_vs_fixed` from useful aggregate tok/s and gates it at 2x,
+    with the serving_pressure conservation fields riding along."""
+    out = {"fixed_tokens_per_sec": 400.0,
+           "continuous_tokens_per_sec": 1000.0,
+           "accepted": 64, "completed": 64,
+           "p50_ms": 100.0, "p99_ms": 250.0}
+    bench.continuous_serving_fields(out)
+    assert out["speedup_vs_fixed"] == pytest.approx(2.5)
+    assert out["audit"] == "ok"
+    assert out["conservation"] == "ok"
+    assert out["tail_ratio_p99_p50"] == pytest.approx(2.5)
+
+
+def test_continuous_fields_flag_under_2x_and_leak():
+    out = {"fixed_tokens_per_sec": 500.0,
+           "continuous_tokens_per_sec": 800.0,
+           "accepted": 64, "completed": 63}
+    bench.continuous_serving_fields(out)
+    assert out["speedup_vs_fixed"] == pytest.approx(1.6)
+    assert out["audit"] == "under-2x"
+    assert out["conservation"] == "leak"
+
+
+def test_continuous_fields_skip_missing_sections():
+    out = {"continuous_tokens_per_sec": 800.0}    # fixed leg absent
+    bench.continuous_serving_fields(out)
+    assert "speedup_vs_fixed" not in out and "audit" not in out
+
+
+def test_continuous_bench_wires_fields_and_per_request_budgets():
+    """Source-level pin: bench_continuous_serving must compare USEFUL
+    tokens (per-request max_new_tokens on the continuous leg, the fixed leg
+    decoding the full cap) and route through continuous_serving_fields."""
+    import inspect
+
+    src = inspect.getsource(bench.bench_continuous_serving)
+    assert "continuous_serving_fields(" in src
+    assert "max_new_tokens=wants[i]" in src
+    assert "useful_tokens" in src
+
+
 def test_decode_attention_bench_reports_vs_baseline():
     """The decode_attention sub-bench must report the Pallas-vs-XLA ratio
     under the contract key `vs_baseline` for every shape entry."""
