@@ -28,6 +28,38 @@ from ..env import get_rank
 _META_NAME = "metadata.json"
 
 
+def np_dtype(name):
+    """Resolve a dtype string from checkpoint metadata, including the
+    ml_dtypes extension types (bfloat16, float8_*) jax arrays carry."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def storable_view(arr):
+    """A view of `arr` that ``np.save`` round-trips losslessly.
+
+    Extension dtypes (ml_dtypes bfloat16/float8) have numpy kind 'V'; np.save
+    writes them as opaque void records and np.load returns '|V2' — the dtype
+    NAME is lost. Storing the same bytes as a uint view of equal itemsize
+    keeps shape and bytes; the reader views back via the metadata dtype."""
+    if arr.dtype.kind == "V":
+        return arr.view(np.dtype(f"u{arr.dtype.itemsize}"))
+    return arr
+
+
+def readback_view(data, want):
+    """Reverse of storable_view: re-view a loaded chunk as its logical dtype."""
+    want = np.dtype(want)
+    if data.dtype != want and data.dtype.kind == "u" \
+            and data.dtype.itemsize == want.itemsize:
+        return data.view(want)
+    return data
+
+
 def _value_of(x):
     return x._value if hasattr(x, "_value") else x
 
@@ -82,7 +114,7 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                      "dtype": str(arr.dtype), "chunks": []}
             if rank == coordinator_rank:
                 cname = f"{name}/0"
-                chunks[cname] = arr
+                chunks[cname] = storable_view(arr)
                 entry["chunks"].append({"offset": [0] * arr.ndim,
                                         "shape": list(arr.shape),
                                         "file": f"data_r{rank}.npz", "key": cname})
@@ -101,7 +133,7 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                 continue
             seen.add(key)
             cname = f"{name}/{len(entry['chunks'])}"
-            chunks[cname] = np.asarray(shard.data)
+            chunks[cname] = storable_view(np.asarray(shard.data))
             entry["chunks"].append({"offset": offset, "shape": cshape,
                                     "file": f"data_r{rank}.npz", "key": cname})
         meta_keys[name] = entry
@@ -169,8 +201,9 @@ def _collate_metadata(path, wait_world=None, timeout=60.0):
         json.dump({"version": 1, "keys": merged}, f)
 
 
-class _ChunkReader:
-    """Lazily-opened npz files with chunk slicing."""
+class ChunkReader:
+    """Lazily-opened npz files with chunk slicing (shared with
+    ``framework.checkpoint.CheckpointManager``'s manifest reader)."""
 
     def __init__(self, path):
         self.path = path
@@ -185,7 +218,7 @@ class _ChunkReader:
         """Assemble the global slice `index` of a metadata entry from its chunks."""
         shape = entry["shape"]
         offset, out_shape = _index_to_offsets(index, shape)
-        out = np.empty(out_shape, dtype=np.dtype(entry["dtype"]))
+        out = np.empty(out_shape, dtype=np_dtype(entry["dtype"]))
         # skip the coverage mask only when a single chunk provably spans the
         # whole tensor; anything else must prove every byte was written
         trivially_covered = (
@@ -204,7 +237,7 @@ class _ChunkReader:
                 continue
             src_sl = tuple(slice(l - co, h - co) for l, h, co in zip(lo, hi, c_off))
             dst_sl = tuple(slice(l - o, h - o) for l, h, o in zip(lo, hi, offset))
-            data = self.file(c["file"])[c["key"]]
+            data = readback_view(self.file(c["file"])[c["key"]], out.dtype)
             out[dst_sl] = data[src_sl]
             if filled is not None:
                 filled[dst_sl] = True
@@ -228,7 +261,7 @@ def load_state_dict(state_dict, path, process_group=None):
     """
     with open(os.path.join(path, _META_NAME)) as f:
         meta = json.load(f)["keys"]
-    reader = _ChunkReader(path)
+    reader = ChunkReader(path)
     try:
         _load_into(state_dict, meta, reader, prefix="")
     finally:

@@ -9,7 +9,6 @@ from __future__ import annotations
 
 import os
 import signal
-import tempfile
 
 from .manager import ELASTIC_EXIT_CODE
 
@@ -28,8 +27,10 @@ class AutoCheckpointer:
     SIGTERM (preemption) sets a flag; the NEXT `step()` call saves and exits
     with ELASTIC_EXIT_CODE (the handler itself must not serialize state
     mid-update). Only rank 0 writes (replicated single-host params); the save
-    is atomic (tmp file + rename) so a kill during save never corrupts the
-    latest checkpoint."""
+    is atomic (framework.io_utils.save is tmp + fsync + rename since round
+    10) so a kill during save never corrupts the latest checkpoint. For
+    TrainStep-native async sharded checkpoints with retention and bit-exact
+    resume, see ``framework.checkpoint.CheckpointManager``."""
 
     def __init__(self, model, optimizer=None, path="./auto_checkpoint",
                  save_every=0, rank=None, install_signal_handler=True):
@@ -75,14 +76,8 @@ class AutoCheckpointer:
         from ....framework.io_utils import save as paddle_save
 
         os.makedirs(self.path, exist_ok=True)
-        fd, tmp = tempfile.mkstemp(dir=self.path, suffix=".tmp")
-        os.close(fd)
-        try:
-            paddle_save(self._state(step), tmp)
-            os.replace(tmp, self._ckpt_file())  # atomic on POSIX
-        finally:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
+        # io_utils.save is itself tmp + fsync + atomic replace (round 10)
+        paddle_save(self._state(step), self._ckpt_file())
 
     def resume(self) -> int:
         """Load the latest checkpoint into model/optimizer; returns the step

@@ -29,18 +29,21 @@ class Generator:
         self._counter = 0
         return self
 
+    def base_key(self):
+        """The stream's base PRNG key, materialized lazily (see manual_seed).
+        A pure function of ``_seed`` — callers folding per-step values into
+        it (TrainStep) stay reproducible across ``set_state`` round-trips."""
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
+        return self._key
+
     def next_key(self):
         """Return a fresh key; advances the stream. Under a TrainStep trace a traced
         base key is folded in instead of the host key, so compiled steps get fresh
         randomness per call rather than a baked-in constant."""
         global _consume_count
         _consume_count += 1  # dispatch cache: randomness makes an op uncacheable
-        if _trace_key is not None:
-            base = _trace_key
-        else:
-            if self._key is None:
-                self._key = jax.random.key(self._seed)
-            base = self._key
+        base = _trace_key if _trace_key is not None else self.base_key()
         k = jax.random.fold_in(base, self._counter)
         self._counter += 1
         return k

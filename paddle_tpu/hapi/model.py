@@ -122,38 +122,136 @@ class Model:
         with no_grad():
             return self.network(*inputs)
 
+    def _checkpoint_provider(self):
+        """The CheckpointManager state provider for this model: the compiled
+        TrainStep when the fast path is live, else a TrainStep constructed
+        purely as a state shuttle (its export/import hooks read/write the
+        SAME live tensors and optimizer stores the eager path mutates —
+        construction never traces, so an untraceable forward is fine)."""
+        if self._optimizer is None:
+            raise RuntimeError("checkpointing needs prepare(optimizer=...)")
+        step = self._train_step
+        if step is None:
+            from ..jit.train import TrainStep
+
+            step = self._train_step = TrainStep(
+                self.network, self._compute_loss, self._optimizer,
+                return_outputs=bool(self._metrics), split_label=True)
+            self._step_proven = False
+        return step
+
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
-            drop_last=False, shuffle=True, num_workers=0, callbacks=None, **kwargs):
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            checkpoint_dir=None, checkpoint_every=0, checkpoint_keep_last=3,
+            checkpoint_keep_every=0, resume="auto", **kwargs):
+        """Train. Preemption tolerance (round 10): pass ``checkpoint_dir=``
+        and every ``checkpoint_every`` optimizer steps the full training
+        state (params, optimizer moments, step counter, RNG, monitor
+        counters) is checkpointed asynchronously; with ``resume="auto"``
+        (default) a restart from the same directory resumes bit-exactly from
+        the newest intact checkpoint — same losses as an uninterrupted run.
+        A final synchronous flush lands on graceful completion (including
+        ``stop_training``), NOT on a crash/kill — that is what the periodic
+        checkpoints are for. Retention/corruption semantics:
+        docs/DEPLOYMENT.md "Preemption & resume"."""
         loader = train_data if isinstance(train_data, DataLoader) else DataLoader(
             train_data, batch_size=batch_size, shuffle=shuffle, drop_last=drop_last,
             num_workers=num_workers)
+        manager = None
+        start_epoch, skip_steps, global_step = 0, 0, 0
+        if checkpoint_dir is not None:
+            from ..framework.checkpoint import CheckpointManager
+
+            manager = CheckpointManager(
+                checkpoint_dir, keep_last=checkpoint_keep_last,
+                keep_every=checkpoint_keep_every)
+            if resume == "auto":
+                provider = self._checkpoint_provider()
+                restored = manager.restore(provider)
+                if restored is not None:
+                    global_step = int(restored)
+                    meta = manager.last_restored["meta"].get("fit", {})
+                    start_epoch = int(meta.get("epoch", 0))
+                    skip_steps = int(meta.get("step_in_epoch", 0))
         cbks = CallbackList(callbacks or [ProgBarLogger(log_freq, verbose=verbose)])
         cbks.set_model(self)
         cbks.on_begin("train")
-        for epoch in range(epochs):
-            cbks.on_epoch_begin(epoch)
-            for m in self._metrics:
-                m.reset()
-            for step, batch in enumerate(loader):
-                cbks.on_batch_begin("train", step, None)
-                x, y = batch[0], batch[1] if len(batch) > 1 else None
-                logs = {"loss": self.train_batch(x, y)}
+        last_saved = global_step
+        fit_pos = (start_epoch, skip_steps)   # next (epoch, step) to run
+
+        def _save(epoch, step_in_epoch, blocking=False):
+            nonlocal last_saved
+            provider = self._checkpoint_provider()
+            manager.monitor = self._step_monitor
+            # the provider meta carries WHERE the fit loop was, so resume
+            # can fast-forward the loader to the exact next batch
+            class _FitProvider:
+                def export_state(self_inner):
+                    snap = provider.export_state()
+                    snap["meta"]["fit"] = {"epoch": epoch,
+                                           "step_in_epoch": step_in_epoch}
+                    return snap
+
+                def import_state(self_inner, state):
+                    provider.import_state(state)
+
+            manager.save(_FitProvider(), global_step, blocking=blocking)
+            last_saved = global_step
+
+        try:
+            for epoch in range(start_epoch, epochs):
+                cbks.on_epoch_begin(epoch)
                 for m in self._metrics:
-                    names = m.name()
-                    vals = m.accumulate()
-                    if not isinstance(vals, (list, tuple)):
-                        vals = [vals]
-                        names = [names] if isinstance(names, str) else names
-                    logs.update(dict(zip(names, vals)))
-                cbks.on_batch_end("train", step, logs)
-            cbks.on_epoch_end(epoch, logs)
-            if eval_data is not None and (epoch + 1) % eval_freq == 0:
-                self.evaluate(eval_data, batch_size=batch_size, verbose=0)
-            if save_dir is not None and (epoch + 1) % save_freq == 0:
-                self.save(f"{save_dir}/epoch_{epoch}")
-            if self.stop_training:
-                break
+                    m.reset()
+                logs = {}
+                for step, batch in enumerate(loader):
+                    if epoch == start_epoch and step < skip_steps:
+                        continue   # resumed mid-epoch: consumed batches
+                    cbks.on_batch_begin("train", step, None)
+                    x, y = batch[0], batch[1] if len(batch) > 1 else None
+                    logs = {"loss": self.train_batch(x, y)}
+                    for m in self._metrics:
+                        names = m.name()
+                        vals = m.accumulate()
+                        if not isinstance(vals, (list, tuple)):
+                            vals = [vals]
+                            names = [names] if isinstance(names, str) else names
+                        logs.update(dict(zip(names, vals)))
+                    global_step += 1
+                    fit_pos = (epoch, step + 1)
+                    cbks.on_batch_end("train", step, logs)
+                    if (manager is not None and checkpoint_every
+                            and global_step % checkpoint_every == 0):
+                        # next step to run on resume is step + 1 (this epoch)
+                        _save(epoch, step + 1)
+                    if self.stop_training:
+                        break
+                if not self.stop_training:
+                    fit_pos = (epoch + 1, 0)
+                cbks.on_epoch_end(epoch, logs)
+                if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                    self.evaluate(eval_data, batch_size=batch_size, verbose=0)
+                if save_dir is not None and (epoch + 1) % save_freq == 0:
+                    self.save(f"{save_dir}/epoch_{epoch}")
+                if self.stop_training:
+                    break
+        except BaseException:
+            # an ungraceful exit (preemption, injected kill, user ^C): drain
+            # pending async writes but DON'T snapshot possibly-torn state
+            if manager is not None:
+                try:
+                    manager.close()
+                except Exception:
+                    pass
+            raise
+        if manager is not None:
+            if global_step > last_saved:
+                # final flush on graceful stop (incl. stop_training):
+                # synchronous, so the newest state is durable before fit
+                # returns; fit_pos resumes exactly where the loop left off
+                _save(fit_pos[0], fit_pos[1], blocking=True)
+            manager.close()
         cbks.on_end("train")
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2, num_workers=0,

@@ -25,6 +25,18 @@ site                   where it is checked
                        the dense-fallback ``model.generate``
 =====================  =====================================================
 
+Training-side sites (``framework/checkpoint.py`` — pass ``injector=`` to the
+``CheckpointManager``; its phase timing also reads ``injector.monotonic``):
+
+=====================  =====================================================
+``ckpt.snapshot``      entry of ``CheckpointManager.save`` (before any state
+                       is host-materialized — a kill here loses the save,
+                       never the previous checkpoint)
+``ckpt.serialize``     start of the shard write on the writer thread
+``ckpt.commit``        before manifest collation + atomic dir rename (a kill
+                       here leaves a torn ``.tmp`` dir restore must ignore)
+=====================  =====================================================
+
 Clock skew: components built with an injector read time through
 ``injector.monotonic`` instead of ``time.monotonic``; ``skew_clock(dt)``
 shifts that clock forward so deadline/backoff expiry is testable without

@@ -16,6 +16,7 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..autograd import tape
 from ..framework import random as _rng
@@ -78,6 +79,8 @@ class TrainStep:
         self._compiled = None  # AOT executable installed by aot_prime()
         self._compiled_avals = None  # arg shapes/dtypes the AOT exe was built for
         self._monitor = None  # observability.training.StepMonitor.bind() target
+        self._pending_monitor_counters = None  # checkpoint-restored counters
+        # parked for a monitor that binds after import_state (the fit path)
         self._seed = 0
         # ZeRO stage recipe (dist.shard_optimizer(opt, ShardingStage1/2/3)):
         # enforced as shardings inside the compiled step — state in, grads mid,
@@ -292,7 +295,7 @@ class TrainStep:
         for i in range(n_steps):
             step_is.append(step0 + 1 + i)
             lrs.append(inner_opt.get_lr())
-            keys.append(jax.random.fold_in(_rng.default_generator()._key,
+            keys.append(jax.random.fold_in(_rng.default_generator().base_key(),
                                            seed0 + 1 + i))
         if advance:
             inner_opt._step_count = step0 + n_steps
@@ -393,6 +396,110 @@ class TrainStep:
                             per[k] = jax.device_put(v, sh)
         return acc
 
+    # ------------------------------------------------- checkpoint state hooks
+    def export_state(self):
+        """Everything a bit-exact resume needs, as live array refs + a
+        JSON-able ``meta`` — the ``framework.checkpoint.CheckpointManager``
+        provider contract. Cheap (no copies): the manager host-materializes
+        immediately, before the next step can donate these buffers."""
+        inner_opt = getattr(self.optimizer, "_inner_opt", self.optimizer)
+        state = {
+            "params": {k: t._value for k, t in self._param_tensors.items()},
+            "acc": self._gather_acc_state(),
+        }
+        mw = getattr(inner_opt, "_master_weights", None)
+        if mw:
+            by_id = {id(t): k for k, t in self._param_tensors.items()}
+            state["master"] = {by_id[pid]: v for pid, v in mw.items()
+                               if pid in by_id}
+        meta = {
+            "step_count": int(inner_opt._step_count),
+            "seed": int(self._seed),
+            "rng": list(_rng.get_rng_state()),
+        }
+        from ..optimizer.lr import LRScheduler
+
+        if isinstance(inner_opt._learning_rate, LRScheduler):
+            meta["lr_sched"] = inner_opt._learning_rate.state_dict()
+        if self._monitor is not None:
+            counters = getattr(self._monitor, "export_counters", None)
+            if counters is not None:
+                meta["monitor"] = counters()
+        state["meta"] = meta
+        return state
+
+    def import_state(self, state):
+        """Reverse of ``export_state``: rebuild params/accumulators/counters
+        so the NEXT step reproduces what an uninterrupted run would have
+        computed, bit for bit. Values land with the avals (shape/dtype) and
+        shardings of the current state, so the cached executable (jit cache
+        or AOT) is reused — restoring never recompiles."""
+        inner_opt = getattr(self.optimizer, "_inner_opt", self.optimizer)
+        for k, t in self._param_tensors.items():
+            v = state.get("params", {}).get(k)
+            if v is not None:
+                t._value = self._place_like(v, t._value)
+        for acc_name, per in (state.get("acc") or {}).items():
+            store = inner_opt._accumulators.setdefault(acc_name, {})
+            for k, v in per.items():
+                t = self._param_tensors.get(k)
+                if t is None:
+                    continue
+                cur = store.get(id(t))
+                val = self._place_like(v, cur)
+                if self._stage is not None:
+                    sh = self._stage.acc_sharding(t, tuple(val.shape))
+                    if sh is not None:
+                        val = jax.device_put(val, sh)
+                store[id(t)] = val
+        if state.get("master"):
+            mw = getattr(inner_opt, "_master_weights", None)
+            if mw is not None:
+                for k, v in state["master"].items():
+                    t = self._param_tensors.get(k)
+                    if t is not None:
+                        mw[id(t)] = self._place_like(v, mw.get(id(t)))
+        meta = state.get("meta") or {}
+        if "step_count" in meta:
+            inner_opt._step_count = int(meta["step_count"])
+        if "seed" in meta:
+            self._seed = int(meta["seed"])
+        if "rng" in meta:
+            _rng.set_rng_state(tuple(meta["rng"]))
+        if "lr_sched" in meta:
+            from ..optimizer.lr import LRScheduler
+
+            if isinstance(inner_opt._learning_rate, LRScheduler):
+                inner_opt._learning_rate.set_state_dict(meta["lr_sched"])
+        if "monitor" in meta:
+            if self._monitor is not None:
+                importer = getattr(self._monitor, "import_counters", None)
+                if importer is not None:
+                    importer(meta["monitor"])
+            else:
+                # no monitor bound yet (fit binds via MonitorCallback on the
+                # first batch, AFTER restore): park the counters for bind()
+                self._pending_monitor_counters = dict(meta["monitor"])
+
+    @staticmethod
+    def _place_like(value, current):
+        """Device-place a restored array with the dtype/sharding of the live
+        value it replaces — the aval must not change or the next launch
+        retraces (the recompile sentinel pins this in tests)."""
+        if current is None:
+            return jnp.asarray(value)
+        dtype = getattr(current, "dtype", None)
+        arr = np.asarray(value) if not isinstance(value, jax.Array) else value
+        if dtype is not None and arr.dtype != dtype:
+            arr = arr.astype(dtype)
+        if isinstance(current, jax.Array) and not isinstance(
+                current, jax.core.Tracer):
+            try:
+                return jax.device_put(arr, current.sharding)
+            except Exception:  # pragma: no cover - exotic placement
+                pass
+        return jnp.asarray(arr)
+
     def _prep_inputs(self, advance: bool):
         """Build the exact traced-input tuple a step consumes. `advance=True` bumps
         the step counter / RNG seed (a real step); `advance=False` peeks at what the
@@ -408,7 +515,7 @@ class TrainStep:
             seed, step_count = self._seed, inner_opt._step_count
         else:
             seed, step_count = self._seed + 1, inner_opt._step_count + 1
-        key = jax.random.fold_in(_rng.default_generator()._key, seed)
+        key = jax.random.fold_in(_rng.default_generator().base_key(), seed)
         step_i = jnp.asarray(step_count, jnp.int32)
         lr = jnp.asarray(inner_opt.get_lr(), jnp.float32)
         return inner_opt, (state, acc_state, step_i, lr, key)

@@ -200,6 +200,24 @@ class StepMonitor:
             "paddle_analysis_findings_total",
             "graph-lint findings on the bound step at first compile",
             labels=("rule", "severity"))
+        # ---- preemption-tolerance accounting (framework.checkpoint feeds
+        # the phase timings; steps feed the useful-time numerator)
+        self._m_goodput = reg.gauge(
+            "paddle_train_goodput",
+            "useful-step time / wall time since first activity "
+            "(wall includes checkpoint snapshots and restore)")
+        self._m_ckpt_seconds = reg.histogram(
+            "paddle_train_checkpoint_seconds",
+            "checkpoint phase wall (snapshot blocks the loop; serialize/"
+            "commit overlap compute; restore is resume cost)",
+            labels=("phase",), buckets=TRAIN_STEP_BUCKETS)
+        self._m_ckpts = reg.counter(
+            "paddle_train_checkpoints_total",
+            "checkpoints by terminal result",
+            labels=("result",))
+        self._useful_s = 0.0
+        self._ckpt_s = 0.0
+        self._wall_t0_us = None
 
     # ------------------------------------------------------------------ time
     def now_us(self) -> float:
@@ -211,6 +229,12 @@ class StepMonitor:
         reporting here. An AOT-primed executable is introspected immediately
         (FLOPs + HBM gauges) and its avals seed the recompile sentinel."""
         step._monitor = self
+        pending = getattr(step, "_pending_monitor_counters", None)
+        if pending is not None:
+            # the step was checkpoint-restored before any monitor was bound:
+            # adopt its counters so the metric series stays continuous
+            self.import_counters(pending)
+            step._pending_monitor_counters = None
         if getattr(step, "_compiled_avals", None) is not None:
             # the AOT program was compiled before we were watching: seed the
             # sentinel with an event but never count it as a recompile
@@ -276,7 +300,10 @@ class StepMonitor:
         """Hook 1/3 (TrainStep.__call__ entry). Returns the t0 token."""
         if not self.enabled:
             return None
-        return self.now_us()
+        now = self.now_us()
+        if self._wall_t0_us is None:
+            self._wall_t0_us = now
+        return now
 
     def _sentinel(self, key, reason_if_new, when_us, count=True):
         """New fingerprint == XLA built a new program: count (except the
@@ -357,6 +384,19 @@ class StepMonitor:
         and feeds the anomaly detector."""
         if not self.enabled or t0 is None:
             return
+        # fetch the loss BEFORE stamping the end time: the fetch is the
+        # honest step boundary (it blocks on the device), and the step wall /
+        # goodput useful-time must include the compute it waits for — with a
+        # periodic cadence (loss_every=K) the fetch step absorbs the queued
+        # compute of the K-1 async-dispatched steps before it, so the SUM of
+        # step walls stays right even when each individual one is not
+        loss_f = None
+        if self.loss_every and (self._step_n + n_steps) % self.loss_every \
+                == 0 and loss_val is not None:
+            try:
+                loss_f = float(loss_val)
+            except Exception:
+                loss_f = None
         end = self.now_us()
         launch = self._launch_us if self._launch_us is not None else t0
         self._launch_us = None
@@ -368,7 +408,12 @@ class StepMonitor:
         dt_s = max((end - t0) / 1e6, 1e-12) / n_steps
         self._m_steps.inc(n_steps)
         self._m_step_seconds.observe(dt_s)
+        self._useful_s += (end - t0) / 1e6
         fields = {"step": self._step_n, "step_time_s": dt_s}
+        gp = self._goodput_at(end)
+        if gp is not None:
+            fields["goodput"] = gp
+            self._m_goodput.set(gp)
         if self.samples_per_step:
             fields["ips"] = self.samples_per_step / dt_s
             self._m_ips.set(fields["ips"])
@@ -379,16 +424,10 @@ class StepMonitor:
         if self._flops_per_step and peak:
             fields["mfu"] = self._flops_per_step / dt_s / peak
             self._m_mfu.set(fields["mfu"])
-        if self.loss_every and self._step_n % self.loss_every == 0 \
-                and loss_val is not None:
-            try:
-                loss_f = float(loss_val)  # blocks: the honest step boundary
-            except Exception:
-                loss_f = None
-            if loss_f is not None:
-                fields["loss"] = loss_f
-                self._m_loss.set(loss_f)
-                self.observe_scalars(self._step_n, loss=loss_f)
+        if loss_f is not None:
+            fields["loss"] = loss_f
+            self._m_loss.set(loss_f)
+            self.observe_scalars(self._step_n, loss=loss_f)
         self.last_fields = fields
         if self.log_writer is not None and self._step_n % self.log_freq == 0:
             for tag in ("loss", "step_time_s", "ips", "tokens_per_sec",
@@ -396,6 +435,71 @@ class StepMonitor:
                 if tag in fields:
                     self.log_writer.add_scalar(f"train/{tag}", fields[tag],
                                                step=self._step_n)
+
+    # ------------------------------------------- checkpointing & goodput
+    def _goodput_at(self, now_us):
+        """useful-step seconds / wall seconds since the first activity this
+        monitor saw (a step, a checkpoint phase, or a restore). Wall time
+        includes checkpoint snapshots, restore, data waits — everything a
+        preemption-tolerant run pays that is not a training step."""
+        if self._wall_t0_us is None:
+            return None
+        wall = (now_us - self._wall_t0_us) / 1e6
+        if wall <= 0:
+            return None
+        return min(1.0, self._useful_s / wall)
+
+    @property
+    def goodput(self):
+        return self._goodput_at(self.now_us())
+
+    @property
+    def useful_step_seconds(self):
+        return self._useful_s
+
+    @property
+    def checkpoint_seconds(self):
+        """Total seconds spent in checkpoint phases (all phases, incl.
+        restore) reported to this monitor."""
+        return self._ckpt_s
+
+    def checkpoint_phase(self, phase, seconds):
+        """``framework.checkpoint.CheckpointManager`` hook: one finished
+        phase (``snapshot`` | ``serialize`` | ``commit`` | ``restore``).
+        Lands in the phase histogram, a span on the step timeline, and the
+        goodput wall window (a restore that happened before the first step
+        backdates the window so resume cost counts against goodput)."""
+        if not self.enabled:
+            return
+        seconds = max(0.0, float(seconds))
+        now = self.now_us()
+        start = now - seconds * 1e6
+        if self._wall_t0_us is None or start < self._wall_t0_us:
+            self._wall_t0_us = start
+        self._ckpt_s += seconds
+        self._m_ckpt_seconds.labels(phase).observe(seconds)
+        self.tracer.record(f"ckpt_{phase}", start, now, self._trace_id,
+                           tags={"step": self._step_n})
+        gp = self._goodput_at(now)
+        if gp is not None:
+            self._m_goodput.set(gp)
+
+    def checkpoint_result(self, ok=True, step=None):
+        """One checkpoint reached a terminal result (manifest committed, or
+        the async writer failed)."""
+        if not self.enabled:
+            return
+        self._m_ckpts.labels("committed" if ok else "failed").inc()
+
+    def export_counters(self):
+        """Counters that survive a preemption inside a checkpoint (the
+        ``TrainStep.export_state`` meta): the step number keeps the metric
+        series continuous across resume. Time windows (goodput) restart per
+        process — resume cost is charged to the NEW process's window."""
+        return {"step_n": int(self._step_n)}
+
+    def import_counters(self, counters):
+        self._step_n = int(counters.get("step_n", self._step_n))
 
     # ---------------------------------------------------------- numerics
     def observe_scalars(self, step=None, loss=None, grad_norm=None):

@@ -44,9 +44,6 @@ BUDGET_EXEMPT = {
     "tests/test_vision_models.py::test_param_counts_sane":
         (44.0, "iterates every zoo architecture once; param-count parity is "
                "the tier-1 canary for the whole vision family"),
-    "tests/test_vision_models.py::test_googlenet_aux_outputs":
-        (21.3, "googlenet builds 3 classifier heads; single heaviest "
-               "remaining non-slow vision model"),
     "tests/test_vision_models.py::test_train_step":
         (15.8, "parametrized train-step smoke across architectures; the "
                "heavy params are already slow-marked (PR 4)"),

@@ -197,3 +197,36 @@ def test_zero3_state_save_load(tmp_path):
         np.testing.assert_allclose(l2, ref[2:], rtol=1e-4, err_msg=f"{l2} vs {ref[2:]}")
     finally:
         dist.set_mesh(prev)
+
+
+def test_extension_dtype_bf16_roundtrip(tmp_path):
+    """bfloat16 (numpy kind 'V' via ml_dtypes) must survive the npz chunk
+    store: np.save writes void dtypes as opaque '|V2' records, losing the
+    dtype name — the storable_view/readback_view pair keeps the bytes as a
+    uint view and re-views on read (round-10 fix, shared with
+    framework.checkpoint)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.distributed.checkpoint import (
+        np_dtype,
+        readback_view,
+        storable_view,
+    )
+
+    want = np.arange(12, dtype=np_dtype("bfloat16")).reshape(3, 4)
+    sd = {"w": paddle.to_tensor(jnp.asarray(want))}
+    save_state_dict(sd, str(tmp_path / "c"))
+    target = {"w": paddle.to_tensor(jnp.zeros((3, 4), jnp.bfloat16))}
+    load_state_dict(target, str(tmp_path / "c"))
+    got = np.asarray(target["w"]._value)
+    assert got.dtype == np_dtype("bfloat16")
+    np.testing.assert_array_equal(got.view(np.uint16), want.view(np.uint16))
+
+    # the helper pair is exactly inverse on every itemsize class
+    for dt in ("bfloat16", "float32", "int8"):
+        arr = np.arange(6).astype(np_dtype(dt))
+        stored = storable_view(arr)
+        assert stored.dtype.kind != "V"
+        back = readback_view(stored, np_dtype(dt))
+        assert back.dtype == np_dtype(dt)
+        np.testing.assert_array_equal(back.view(np.uint8), arr.view(np.uint8))

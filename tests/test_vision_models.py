@@ -45,6 +45,9 @@ def test_forward_shape(ctor, in_shape, n_out):
     assert list(out.shape) == [in_shape[0], n_out]
 
 
+@pytest.mark.slow   # ~21s: pays the tier-1 budget for the PR 7 checkpoint
+# suite (ROADMAP budget rule); googlenet still compiles in param_counts_sane
+# and the aux heads run in the slow-included suite
 def test_googlenet_aux_outputs():
     model = models.googlenet(num_classes=7)
     model.eval()
