@@ -784,6 +784,50 @@ def graph_lint_fields(out):
     return out
 
 
+def bench_thread_lint(on_accel, dev):
+    """Thread-lint leg (ISSUE-8): run the static lock-order/guarded-field
+    pass (paddle_tpu.analysis.threads) over the framework's own source and
+    report findings-by-rule. The gate is `high_total == 0`: a high finding
+    means a threaded runtime module ships an unguarded shared write, a
+    blocking call under a lock, or a lock-order cycle. Allowlisted findings
+    are counted separately — suppression is visible, never silent. Pure
+    host-side AST analysis: identical on or off accelerator."""
+    import time as _time
+
+    from paddle_tpu.analysis.threads import analyze_threads, lock_order_graph
+
+    t0 = _time.perf_counter()
+    report = analyze_threads()
+    edges = lock_order_graph()
+    out = {
+        "findings": [f.to_dict() for f in report.findings],
+        "suppressed": [{"rule": f.rule, "reason": e.reason}
+                       for f, e in report.suppressed],
+        "suppressed_total": len(report.suppressed),
+        "lock_order_edges": len(edges),
+        "lint_wall_sec": round(_time.perf_counter() - t0, 3),
+    }
+    thread_lint_fields(out)
+    return out, None
+
+
+def thread_lint_fields(out):
+    """Aggregate + audit fields for the thread_lint section: findings-by-
+    rule, `high_total` and `audit` = ok iff zero un-allowlisted high
+    findings. Pure function of the measured dict so tests can pin the
+    wiring on synthetic inputs (same contract as graph_lint_fields)."""
+    by_rule: dict = {}
+    high = 0
+    for f in out.get("findings", ()):
+        by_rule[f["rule"]] = by_rule.get(f["rule"], 0) + 1
+        if f.get("severity") == "high":
+            high += 1
+    out["findings_by_rule"] = by_rule
+    out["high_total"] = high
+    out["audit"] = "ok" if high == 0 else "lint-high"
+    return out
+
+
 def bench_decode_attention(on_accel, dev):
     """Isolated decode-attention kernel bench: split-KV Pallas vs the XLA
     grouped-einsum path over a dense cache (q = 1 token). Steps are chained
@@ -1056,6 +1100,10 @@ def main():
     except Exception:
         pass
     try:
+        tlint, tlint_err = bench_thread_lint(on_accel, dev)
+    except Exception as e:
+        tlint, tlint_err = None, {"error": repr(e)[:200]}
+    try:
         decode_attn, decode_attn_err = bench_decode_attention(on_accel, dev)
     except Exception as e:
         decode_attn, decode_attn_err = None, {"error": repr(e)[:200]}
@@ -1098,6 +1146,7 @@ def main():
                                              else train_obs_err),
             "checkpoint_overhead": ckpt if ckpt is not None else ckpt_err,
             "graph_lint": lint if lint is not None else lint_err,
+            "thread_lint": tlint if tlint is not None else tlint_err,
             "decode_attention": (decode_attn if decode_attn is not None
                                  else decode_attn_err),
             "long_context": long_ctx if long_ctx is not None else long_ctx_err,

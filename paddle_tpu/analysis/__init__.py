@@ -18,6 +18,13 @@ recompile-hazard, collective-axis (catalog: docs/ANALYSIS.md). Gating:
 zoo), the bench ``graph_lint`` leg, and ``StepMonitor(lint=True)`` which
 lints once at first compile and counts findings in
 ``paddle_analysis_findings_total{rule,severity}``.
+
+The package's second leg is the THREAD lint (``analysis/threads.py``): the
+same Finding/Allowlist/Report machinery run as an AST pass over the host
+runtime itself — lock-order cycles, unguarded shared writes, blocking calls
+under locks — plus the runtime lock witness (``analysis/lockwitness.py``)
+the chaos suite activates to check the observed acquisition order against
+the static graph. ``--self-check`` gates both.
 """
 from .core import (  # noqa: F401
     Program,
@@ -37,4 +44,16 @@ from .findings import (  # noqa: F401
     AllowlistEntry,
     Finding,
 )
+from .lockwitness import (  # noqa: F401
+    LockWitness,
+    make_lock,
+    make_rlock,
+)
 from .rules import RULES  # noqa: F401
+from .threads import (  # noqa: F401
+    BUILTIN_THREAD_ALLOWLIST,
+    RUNTIME_MODULES,
+    THREAD_RULES,
+    analyze_threads,
+    lock_order_graph,
+)

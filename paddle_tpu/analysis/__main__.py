@@ -1,10 +1,17 @@
-"""``python -m paddle_tpu.analysis`` — lint the bundled model zoo programs.
+"""``python -m paddle_tpu.analysis`` — lint the zoo programs AND the host
+runtime's own threading discipline.
 
-Exit status is the gate: 0 when every program is clean at high severity
-(allowlisted findings are printed with their justification, not hidden),
-1 when any un-allowlisted high-severity finding survives. Wire
-``--self-check`` into CI next to the tier-1 tests; ``--json`` emits the
-same findings-by-rule structure the bench ``graph_lint`` leg reports.
+Exit status is the gate: 0 when every zoo program is clean at high severity
+AND the thread lint over the framework source reports zero un-allowlisted
+high findings (allowlisted findings are printed with their justification,
+not hidden); 1 otherwise. Wire ``--self-check`` into CI next to the tier-1
+tests; ``--json`` emits the same findings-by-rule structure the bench
+``graph_lint`` / ``thread_lint`` legs report.
+
+``--programs a,b`` restricts to a zoo subset (graph lint only);
+``--threads [PATH]`` runs ONLY the thread lint — over PATH (a file or
+directory, every module treated as runtime: the seeded-violation fixture
+mode) or, with no PATH, over the installed ``paddle_tpu`` package.
 """
 from __future__ import annotations
 
@@ -13,18 +20,38 @@ import json
 import sys
 
 
+def _thread_report(path=None):
+    from .threads import analyze_threads, thread_lint_paths
+
+    if path is None:
+        return analyze_threads()
+    import os
+
+    paths = [path] if os.path.isfile(path) else thread_lint_paths(path)
+    # explicit paths are fixture/audit mode: everything is runtime-strict
+    return analyze_threads(paths=paths, runtime_modules=("*",))
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m paddle_tpu.analysis",
         description="Graph lint over the bundled model zoo programs "
-                    "(GPT/ResNet train steps, dense+paged decode).")
+                    "(GPT/ResNet train steps, dense+paged decode) plus the "
+                    "thread lint over the host runtime source.")
     parser.add_argument("--self-check", action="store_true",
-                        help="lint the model zoo and exit non-zero on any "
+                        help="lint the model zoo AND the framework's own "
+                             "threading discipline, exit non-zero on any "
                              "high-severity finding (the default behavior; "
                              "the flag exists for explicit CI wiring)")
     parser.add_argument("--programs", default=None,
                         help="comma-separated subset of zoo programs "
-                             "(default: all)")
+                             "(default: all; implies graph lint only)")
+    parser.add_argument("--threads", nargs="?", const="", default=None,
+                        metavar="PATH",
+                        help="run ONLY the thread lint: over PATH (file or "
+                             "directory, strict/runtime severities — the "
+                             "seeded-fixture mode) or the installed "
+                             "paddle_tpu package when PATH is omitted")
     parser.add_argument("--json", action="store_true",
                         help="emit one JSON object instead of text")
     parser.add_argument("--list-rules", action="store_true",
@@ -32,25 +59,35 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     from .rules import RULES
+    from .threads import THREAD_RULES
 
     if args.list_rules:
         for rule_id, fn in RULES.items():
             doc = (fn.__doc__ or "").strip().split("\n")[0]
             print(f"{rule_id:18s} {doc}")
+        for rule_id, doc in THREAD_RULES.items():
+            print(f"{rule_id:18s} [threads] {doc}")
         return 0
 
-    from .zoo import ZOO_PROGRAMS, zoo_reports
+    reports = []
+    if args.threads is not None:
+        reports.append(_thread_report(args.threads or None))
+    else:
+        from .zoo import ZOO_PROGRAMS, zoo_reports
 
-    include = None
-    if args.programs:
-        include = [p.strip() for p in args.programs.split(",") if p.strip()]
-        unknown = [p for p in include if p not in ZOO_PROGRAMS]
-        if unknown:
-            print(f"unknown program(s) {unknown}; available: "
-                  f"{sorted(ZOO_PROGRAMS)}", file=sys.stderr)
-            return 2
+        include = None
+        if args.programs:
+            include = [p.strip() for p in args.programs.split(",")
+                       if p.strip()]
+            unknown = [p for p in include if p not in ZOO_PROGRAMS]
+            if unknown:
+                print(f"unknown program(s) {unknown}; available: "
+                      f"{sorted(ZOO_PROGRAMS)}", file=sys.stderr)
+                return 2
+        reports.extend(zoo_reports(include=include))
+        if include is None:     # full self-check covers the host runtime too
+            reports.append(_thread_report())
 
-    reports = zoo_reports(include=include)
     high_total = sum(len(r.high()) for r in reports)
     if args.json:
         print(json.dumps({

@@ -168,11 +168,16 @@ def gpt_prefill_chunk_report(thresholds=None, allowlist=None):
     return analyze(
         run, model._decode_state(jnp.bfloat16), jnp.asarray(ids),
         jnp.asarray(offs, jnp.int32), jnp.asarray(lens, jnp.int32),
-        jnp.asarray(tbl, jnp.int32), tuple(kv.k_pages), tuple(kv.v_pages),
+        jnp.asarray(tbl, jnp.int32),
+        # sampling params are TRACED per-slot inputs (PR 8): mixed-sampler
+        # traffic shares this one program, so they lint as arguments
+        jnp.zeros((S,), jnp.float32), jnp.zeros((S,), jnp.int32),
+        tuple(kv.k_pages), tuple(kv.v_pages),
         jax.random.key(0),
         _name="gpt.decode.paged_prefill_chunk",
         _arg_labels=("state", "chunk", "offsets", "chunk_lens", "tables",
-                     "k_pages", "v_pages", "rng_key"),
+                     "temperatures", "top_ks", "k_pages", "v_pages",
+                     "rng_key"),
         _thresholds=thresholds, _allowlist=allowlist)
 
 
@@ -195,10 +200,12 @@ def gpt_decode_step_report(thresholds=None, allowlist=None):
         run, model._decode_state(jnp.bfloat16), jnp.asarray(tok),
         jnp.asarray(lens, jnp.int32), jnp.asarray(act),
         jnp.asarray(lmax, jnp.int32), jnp.asarray(tbl, jnp.int32),
+        jnp.zeros((S,), jnp.float32), jnp.zeros((S,), jnp.int32),
         tuple(kv.k_pages), tuple(kv.v_pages), jax.random.key(0),
         _name="gpt.decode.paged_step",
         _arg_labels=("state", "tokens", "lengths", "active", "max_lens",
-                     "tables", "k_pages", "v_pages", "rng_key"),
+                     "tables", "temperatures", "top_ks", "k_pages",
+                     "v_pages", "rng_key"),
         _thresholds=thresholds, _allowlist=allowlist)
 
 

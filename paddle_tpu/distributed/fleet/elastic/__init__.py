@@ -14,3 +14,11 @@ from .manager import (  # noqa: F401
     ElasticStatus,
 )
 from .checkpoint import AutoCheckpointer  # noqa: F401
+# CheckpointManager-era preemption hook (PR 8): fit(checkpoint_dir=...)
+# installs it so the launch controller's SIGTERM triggers a final
+# synchronous flush + ELASTIC_EXIT_CODE — the AutoCheckpointer contract,
+# spoken by the async sharded checkpoint stack
+from ....framework.checkpoint import (  # noqa: F401
+    PreemptionExit,
+    PreemptionFlush,
+)

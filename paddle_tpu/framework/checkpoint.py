@@ -56,9 +56,11 @@ import zlib
 
 import numpy as np
 
+from ..analysis.lockwitness import make_lock
 from .io_utils import fsync_dir, fsync_file
 
-__all__ = ["CheckpointManager", "CheckpointCorruptWarning", "latest_step"]
+__all__ = ["CheckpointManager", "CheckpointCorruptWarning", "latest_step",
+           "PreemptionFlush", "PreemptionExit"]
 
 _MANIFEST = "manifest.json"
 _STEP_PREFIX = "step_"
@@ -69,6 +71,63 @@ class CheckpointCorruptWarning(UserWarning):
     """A checkpoint directory failed integrity validation (torn manifest,
     missing/truncated/corrupt shard). The manager falls back to the previous
     intact checkpoint instead of crashing — but the operator should know."""
+
+
+class PreemptionExit(SystemExit):
+    """Raised by the training loop after a SIGTERM-triggered final flush.
+
+    Subclasses SystemExit carrying ``ELASTIC_EXIT_CODE`` (101), so an
+    un-caught preemption exits the worker process with the code the elastic
+    launch controller treats as "restart me, this is not a crash" — the
+    same contract the legacy ``AutoCheckpointer`` spoke, now available to
+    every ``CheckpointManager``-checkpointed fit loop."""
+
+
+class PreemptionFlush:
+    """SIGTERM -> flag; the training loop polls and flushes synchronously.
+
+    Pod preemption lands as SIGTERM with a grace window (the elastic launch
+    controller's ``stop_pod`` sends exactly that). The handler itself must
+    not serialize state — the signal can land mid-optimizer-update — so it
+    only sets ``preempted``; the fit loop checks the flag at the next batch
+    boundary, takes a final SYNCHRONOUS ``CheckpointManager.save`` of
+    well-formed post-step state, and raises :class:`PreemptionExit`.
+
+    ``install()`` is a no-op outside the main thread (Python only delivers
+    signals there) and chains nothing: the previous handler is restored by
+    ``restore()`` in the fit loop's ``finally``."""
+
+    def __init__(self):
+        self.preempted = False
+        self.installed = False
+        self._prev = None
+
+    def install(self) -> "PreemptionFlush":
+        import signal
+
+        try:
+            self._prev = signal.signal(signal.SIGTERM, self._on_sigterm)
+            self.installed = True
+        except ValueError:      # not the main thread: poll-only mode
+            self.installed = False
+        return self
+
+    def _on_sigterm(self, signum, frame):
+        self.preempted = True
+
+    def restore(self):
+        if not self.installed:
+            return
+        import signal
+
+        signal.signal(signal.SIGTERM, self._prev or signal.SIG_DFL)
+        self.installed = False
+
+    @staticmethod
+    def exit_code() -> int:
+        from ..distributed.fleet.elastic.manager import ELASTIC_EXIT_CODE
+
+        return ELASTIC_EXIT_CODE
 
 
 def _step_dirname(step):
@@ -191,7 +250,9 @@ class CheckpointManager:
         self._q: queue.Queue = queue.Queue(maxsize=1)
         self._writer = None
         self._writer_err = None
-        self._lock = threading.Lock()
+        # one lock guards writer lifecycle AND the cross-thread scalars
+        # (saves/commits/last_timings/_writer_err) — thread-lint discipline
+        self._lock = make_lock("checkpoint.CheckpointManager._lock")
         os.makedirs(self.directory, exist_ok=True)
 
     # ----------------------------------------------------------------- clock
@@ -205,8 +266,9 @@ class CheckpointManager:
             inj.check(site)
 
     def _phase(self, phase, seconds):
-        self.last_timings[phase] = seconds
-        mon = self.monitor
+        with self._lock:    # caller thread (snapshot) and writer both land
+            self.last_timings[phase] = seconds
+        mon = self.monitor  # monitor has its own locking; call outside ours
         if mon is not None:
             mon.checkpoint_phase(phase, seconds)
 
@@ -222,7 +284,8 @@ class CheckpointManager:
         chunks, entries = self._snapshot(snap)
         meta = dict(snap.get("meta") or {})
         self._phase("snapshot", self._now() - t0)
-        self.saves += 1
+        with self._lock:
+            self.saves += 1
         job = {"step": int(step), "chunks": chunks, "entries": entries,
                "meta": meta}
         if blocking is None:
@@ -309,12 +372,14 @@ class CheckpointManager:
             try:
                 self._write(job)
             except BaseException as e:   # surfaced on next save()/wait()
-                self._writer_err = e
+                with self._lock:
+                    self._writer_err = e
             finally:
                 self._q.task_done()
 
     def _raise_writer_error(self):
-        err, self._writer_err = self._writer_err, None
+        with self._lock:
+            err, self._writer_err = self._writer_err, None
         if err is not None:
             mon = self.monitor
             if mon is not None:
@@ -373,7 +438,8 @@ class CheckpointManager:
         if self.rank == 0:
             self._commit(step, tmp, final, job["meta"])
             self._phase("commit", self._now() - t0)
-            self.commits += 1
+            with self._lock:
+                self.commits += 1
             mon = self.monitor
             if mon is not None:
                 mon.checkpoint_result(ok=True, step=step)
@@ -384,13 +450,13 @@ class CheckpointManager:
         fsync, and atomically rename the directory into place. The manifest
         is the commit record — a directory without one is torn by definition
         and ignored at restore."""
-        deadline = time.monotonic() + timeout
+        deadline = self._now() + timeout    # injectable (skewable) clock
         while True:
             sidecars = [n for n in os.listdir(tmp)
                         if n.startswith("meta_r") and n.endswith(".json")]
             if len(sidecars) >= self.world_size:
                 break
-            if time.monotonic() > deadline:
+            if self._now() > deadline:
                 raise TimeoutError(
                     f"checkpoint step {step}: {len(sidecars)}/"
                     f"{self.world_size} rank sidecars within {timeout}s — "
@@ -515,8 +581,9 @@ class CheckpointManager:
                 continue
             state = self._read_state(d, manifest, provider)
             provider.import_state(state)
-            self.last_restored = {"step": s, "dir": d,
-                                  "meta": manifest.get("meta", {})}
+            with self._lock:
+                self.last_restored = {"step": s, "dir": d,
+                                      "meta": manifest.get("meta", {})}
             dt = self._now() - t0
             self._phase("restore", dt)
             return s

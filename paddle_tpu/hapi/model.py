@@ -152,20 +152,26 @@ class Model:
         (default) a restart from the same directory resumes bit-exactly from
         the newest intact checkpoint — same losses as an uninterrupted run.
         A final synchronous flush lands on graceful completion (including
-        ``stop_training``), NOT on a crash/kill — that is what the periodic
-        checkpoints are for. Retention/corruption semantics:
+        ``stop_training``) AND on preemption: with a checkpoint_dir active,
+        fit installs a SIGTERM hook (``framework.checkpoint.PreemptionFlush``
+        — the elastic launch controller's ``stop_pod`` delivers exactly that
+        signal) which flushes the current state synchronously at the next
+        batch boundary and exits with ``ELASTIC_EXIT_CODE`` so the
+        controller restarts-not-fails the worker. A hard crash/kill still
+        relies on the periodic checkpoints. Retention/corruption semantics:
         docs/DEPLOYMENT.md "Preemption & resume"."""
         loader = train_data if isinstance(train_data, DataLoader) else DataLoader(
             train_data, batch_size=batch_size, shuffle=shuffle, drop_last=drop_last,
             num_workers=num_workers)
-        manager = None
+        manager, flush = None, None
         start_epoch, skip_steps, global_step = 0, 0, 0
         if checkpoint_dir is not None:
-            from ..framework.checkpoint import CheckpointManager
+            from ..framework.checkpoint import CheckpointManager, PreemptionFlush
 
             manager = CheckpointManager(
                 checkpoint_dir, keep_last=checkpoint_keep_last,
                 keep_every=checkpoint_keep_every)
+            flush = PreemptionFlush().install()
             if resume == "auto":
                 provider = self._checkpoint_provider()
                 restored = manager.restore(provider)
@@ -225,6 +231,16 @@ class Model:
                             and global_step % checkpoint_every == 0):
                         # next step to run on resume is step + 1 (this epoch)
                         _save(epoch, step + 1)
+                    if flush is not None and flush.preempted:
+                        # SIGTERM (pod preemption): final SYNCHRONOUS flush
+                        # of the post-step state, then exit with the elastic
+                        # restart code — the launch controller's grace
+                        # window exists to cover exactly this save
+                        _save(epoch, step + 1, blocking=True)
+                        manager.close()
+                        from ..framework.checkpoint import PreemptionExit
+
+                        raise PreemptionExit(flush.exit_code())
                     if self.stop_training:
                         break
                 if not self.stop_training:
@@ -239,12 +255,16 @@ class Model:
         except BaseException:
             # an ungraceful exit (preemption, injected kill, user ^C): drain
             # pending async writes but DON'T snapshot possibly-torn state
+            # (the PreemptionExit path above already flushed synchronously)
             if manager is not None:
                 try:
                     manager.close()
                 except Exception:
                     pass
             raise
+        finally:
+            if flush is not None:
+                flush.restore()
         if manager is not None:
             if global_step > last_saved:
                 # final flush on graceful stop (incl. stop_training):

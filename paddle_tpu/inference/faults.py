@@ -44,8 +44,9 @@ sleeping.
 """
 from __future__ import annotations
 
-import threading
 import time
+
+from ..analysis.lockwitness import make_lock
 
 __all__ = ["ThreadDeath", "FaultInjector"]
 
@@ -75,7 +76,7 @@ class FaultInjector:
     """Counter-armed fault injection with a skewable monotonic clock."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("faults.FaultInjector._lock")
         self._faults: dict[str, list[_Fault]] = {}
         self._calls: dict[str, int] = {}
         self._skew = 0.0
@@ -113,10 +114,12 @@ class FaultInjector:
         if hit is None:
             return
         if hit.delay:
-            self.log.append((site, "delay"))
-            time.sleep(hit.delay)
+            with self._lock:    # log shares the injector lock everywhere
+                self.log.append((site, "delay"))
+            time.sleep(hit.delay)   # deliberately OUTSIDE the lock
         if hit.error is not None:
-            self.log.append((site, repr(hit.error)))
+            with self._lock:
+                self.log.append((site, repr(hit.error)))
             raise hit.error
 
     def calls(self, site) -> int:

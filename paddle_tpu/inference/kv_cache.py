@@ -18,9 +18,10 @@ from __future__ import annotations
 
 import itertools
 import math
-import threading
 
 import numpy as np
+
+from ..analysis.lockwitness import make_rlock
 
 __all__ = ["CacheOutOfBlocks", "BlockAllocator", "PagedKVCache"]
 
@@ -118,7 +119,7 @@ class PagedKVCache:
         # host bookkeeping is hit from HTTP handler threads (admission
         # checks), the batcher thread (reserve/release), and clients
         # (gather); RLock because reserve -> _evict_lru -> release re-enters
-        self._lock = threading.RLock()
+        self._lock = make_rlock("kv_cache.PagedKVCache._lock")
 
     # ------------------------------------------------------------- identity
     def signature(self):
@@ -344,24 +345,28 @@ class PagedKVCache:
 
     # ------------------------------------------------------------ device I/O
     def commit(self, k_pages, v_pages):
-        """Store the pools a compiled step returned (functional update)."""
+        """Store the pools a compiled step returned (functional update).
+        Locked: a concurrent gather() must see a matched (k, v) pair, never
+        one old and one new pool list (thread-lint unguarded-write fix)."""
         if len(k_pages) != self.num_layers or len(v_pages) != self.num_layers:
             raise ValueError("pool list length != num_layers")
-        self.k_pages = list(k_pages)
-        self.v_pages = list(v_pages)
+        with self._lock:
+            self.k_pages = list(k_pages)
+            self.v_pages = list(v_pages)
 
     def gather(self, request_id, layer: int):
         """Host-side contiguous [length, Hkv, D] (k, v) view of a request's
-        cache — debug/audit path; the kernel never gathers."""
+        cache — debug/audit path; the kernel never gathers. Locked end to
+        end so a mid-gather commit() cannot mix pool generations."""
         with self._lock:
             req = self._requests[request_id]
             n = self.blocks_for(max(req.length, 1))
             tbl = np.asarray(req.blocks[:n])
 
-        def _dense(pages):
-            # [Hkv, n, BS, D] -> [n*BS, Hkv, D]
-            arr = np.asarray(pages)[:, tbl]
-            arr = arr.reshape(self.num_kv_heads, -1, self.head_dim)
-            return arr.swapaxes(0, 1)[:req.length]
+            def _dense(pages):
+                # [Hkv, n, BS, D] -> [n*BS, Hkv, D]
+                arr = np.asarray(pages)[:, tbl]
+                arr = arr.reshape(self.num_kv_heads, -1, self.head_dim)
+                return arr.swapaxes(0, 1)[:req.length]
 
-        return _dense(self.k_pages[layer]), _dense(self.v_pages[layer])
+            return _dense(self.k_pages[layer]), _dense(self.v_pages[layer])

@@ -44,11 +44,12 @@ ServiceUnavailable instead of stranding clients.
 """
 from __future__ import annotations
 
+import itertools
 import queue
-import threading
 
 import numpy as np
 
+from ..analysis.lockwitness import make_lock
 from .faults import ThreadDeath
 from .kv_cache import CacheOutOfBlocks
 from .resilience import DeadlineExceeded, ServiceUnavailable
@@ -63,7 +64,8 @@ class _SlotSeq:
     """One in-flight sequence bound to a scheduler slot."""
 
     __slots__ = ("req", "rid", "ids", "out_dtype", "plen", "pos", "tok",
-                 "length", "generated", "table", "phase", "max_new", "order")
+                 "length", "generated", "table", "phase", "max_new", "order",
+                 "temperature", "top_k")
 
     def __init__(self, req, rid, ids, out_dtype, max_new, order):
         self.req = req
@@ -79,6 +81,10 @@ class _SlotSeq:
         self.phase = _PREFILL
         self.max_new = int(max_new)
         self.order = order          # admit sequence number (FIFO fairness)
+        # per-request sampling params: traced [S]-array inputs of the step
+        # programs, so mixed-sampler slots share one compiled program
+        self.temperature = float(req.temperature or 0.0)
+        self.top_k = int(req.top_k or 0)
 
 
 class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
@@ -125,9 +131,14 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
         self.decode_steps = int(decode_steps)
         self.eos_token_id = (None if eos_token_id is None
                              else int(eos_token_id))
+        # per-tick RNG seed draw (atomic): sampling slots get fresh noise
+        # each tick; greedy output is seed-independent (argmax)
+        self._seed = itertools.count(1)
         # slot state exists BEFORE super().__init__ starts the loop thread
         self._slots: list = [None] * self.max_slots
-        self._slot_lock = threading.Lock()  # gauges scrape from other threads
+        # gauges scrape from other threads; witness-wrapped under chaos
+        self._slot_lock = make_lock(
+            "scheduler.ContinuousGenerateBatchingPredictor._slot_lock")
         self.max_seq_len = None             # finalized below (needs kv_cache)
         self.table_width = None
         super().__init__(model, max_batch_size=max_slots,
@@ -192,18 +203,28 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
 
     # ---------------------------------------------------------------- client
     def infer(self, ids, timeout=None, deadline=None, trace_id=None,
-              max_new_tokens=None):
+              max_new_tokens=None, temperature=None, top_k=None):
         """One prompt in -> prompt + generated ids out.
 
         `max_new_tokens` (<= the server cap) asks for fewer tokens than the
         server-wide maximum; the sequence retires the moment it has them and
         its slot/blocks go to the next request — the aggregate-throughput
-        win whole-request batching cannot give."""
+        win whole-request batching cannot give.
+
+        `temperature` / `top_k` are PER-REQUEST sampler knobs (default
+        greedy). They ride the step programs as traced per-slot arrays, so
+        a greedy request and a temperature-0.8/top-k-40 request decode in
+        the SAME tick of the SAME compiled program — mixed-sampler traffic
+        never forks step programs (recompile-sentinel-pinned in tests)."""
         req = self._make_request([np.asarray(ids)], timeout, deadline,
                                  trace_id)
         if max_new_tokens is not None:
             req.max_new = max(1, min(int(max_new_tokens),
                                      self.max_new_tokens))
+        if temperature is not None:
+            req.temperature = float(temperature)
+        if top_k is not None:
+            req.top_k = int(top_k)
         return self._submit(req)
 
     def _admission_check(self, arrays):
@@ -286,8 +307,8 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
             plen = len(arr)
             max_new = (req.max_new if req.max_new is not None
                        else self.max_new_tokens)
-            self._rid += 1
-            rid = ("cseq", self._rid)
+            seq_n = next(self._rid)     # atomic draw (itertools.count)
+            rid = ("cseq", seq_n)
             tr = req.trace
             traced = self.tracer.enabled
             t_kv = self.tracer.now_us() if traced else 0.0
@@ -304,7 +325,7 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
                          blocks=self.kv_cache.blocks_for(plen + max_new))
             self._end_queue_wait([req])
             seq = _SlotSeq(req, rid, np.asarray(arr, np.int64), arr.dtype,
-                           max_new, self._rid)
+                           max_new, seq_n)
             seq.table = self.kv_cache.block_table(rid,
                                                   pad_to=self.table_width)
             with self._slot_lock:
@@ -414,11 +435,15 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
         chunk = np.zeros((S, C), np.int64)
         offs = np.zeros(S, np.int64)
         lens = np.zeros(S, np.int64)
+        temps = np.zeros(S, np.float32)
+        tks = np.zeros(S, np.int32)
         tables = np.zeros((S, self.table_width), np.int32)
         for i, s, take in picks:
             chunk[i, :take] = s.ids[s.pos:s.pos + take]
             offs[i] = s.pos
             lens[i] = take
+            temps[i] = s.temperature
+            tks[i] = s.top_k
             tables[i] = s.table
         reqs = [s.req for _, s, _ in picks]
         traced = self.tracer.enabled
@@ -428,8 +453,9 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
                 self._faults.check("predictor.generate")
             tk = self.model.prefill_chunk(
                 chunk, offs, lens, self.kv_cache, tables,
+                temperature=temps, top_k=tks,
                 eos_token_id=self.eos_token_id,
-                decode_kernel=self.decode_kernel,
+                decode_kernel=self.decode_kernel, seed=next(self._seed),
                 timing_hook=self._gen_timing)
         except ThreadDeath:
             raise
@@ -467,12 +493,16 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
         lengths = np.zeros(S, np.int64)
         maxlens = np.zeros(S, np.int64)
         active = np.zeros(S, bool)
+        temps = np.zeros(S, np.float32)
+        tks = np.zeros(S, np.int32)
         tables = np.zeros((S, self.table_width), np.int32)
         for i, s in dec:
             tok[i] = s.tok
             lengths[i] = s.length
             maxlens[i] = s.plen + s.max_new   # write ceiling: reserved rows
             active[i] = True
+            temps[i] = s.temperature
+            tks[i] = s.top_k
             tables[i] = s.table
         reqs = [s.req for _, s in dec]
         traced = self.tracer.enabled
@@ -482,8 +512,9 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
                 self._faults.check("predictor.generate")
             toks = self.model.decode_step(
                 tok, lengths, active, self.kv_cache, tables, steps=T,
-                max_lens=maxlens, eos_token_id=self.eos_token_id,
-                decode_kernel=self.decode_kernel,
+                max_lens=maxlens, temperature=temps, top_k=tks,
+                eos_token_id=self.eos_token_id,
+                decode_kernel=self.decode_kernel, seed=next(self._seed),
                 timing_hook=self._gen_timing)
         except ThreadDeath:
             raise

@@ -21,7 +21,9 @@ deterministic faults at the seams for the chaos tests.
 """
 from __future__ import annotations
 
+import collections
 import io
+import itertools
 import math
 import queue
 import threading
@@ -29,6 +31,7 @@ import time
 
 import numpy as np
 
+from ..analysis.lockwitness import make_lock
 from ..observability.metrics import MetricsRegistry, render_prometheus
 from ..observability.trace import RequestTrace, Tracer, new_trace_id
 from .faults import ThreadDeath
@@ -70,8 +73,8 @@ class _Request:
     mutually exclusive instead of racy."""
 
     __slots__ = ("arrays", "event", "result", "error", "deadline", "retries",
-                 "defers", "t0", "trace", "enq_us", "max_new", "_lock",
-                 "_state")
+                 "defers", "t0", "trace", "enq_us", "max_new", "temperature",
+                 "top_k", "_lock", "_state")
 
     def __init__(self, arrays, deadline=None, trace=None):
         self.arrays = arrays
@@ -85,7 +88,9 @@ class _Request:
         self.trace = trace      # observability.trace.RequestTrace | None
         self.enq_us = None      # queue-entry stamp (tracer µs) of this pass
         self.max_new = None     # per-request token budget (continuous sched.)
-        self._lock = threading.Lock()
+        self.temperature = None  # per-request sampling (continuous sched.)
+        self.top_k = None
+        self._lock = make_lock("serving._Request._lock")
         self._state = _PENDING
 
     @property
@@ -158,7 +163,10 @@ class BatchingPredictor:
         self._stop = threading.Event()
         self._draining = threading.Event()
         self._busy = False
-        self.batch_sizes: list[int] = []  # observability: actual batch fill
+        # deque: appends from the batcher thread are atomic (thread-lint
+        # documented-atomic type; a plain list.append is too under the GIL,
+        # but the contract is explicit this way)
+        self.batch_sizes: collections.deque = collections.deque()
         self._sup = Supervisor(self._make_thread, name=type(self).__name__,
                                max_restarts=max_restarts)
         self._sup.start()
@@ -377,9 +385,11 @@ class BatchingPredictor:
         waking EARLY once the bucket fills (a full batch arriving instantly
         used to still pay the whole window; VERDICT r5 weak #5)."""
         batch = [first] if self._usable(first) else []
-        deadline = time.monotonic() + self.max_delay
+        # the injectable clock (faults.monotonic under chaos): skew-driven
+        # tests steer the collection window too (thread-lint raw-clock rule)
+        deadline = self._clock() + self.max_delay
         while len(batch) < self.max_batch_size:
-            remaining = deadline - time.monotonic()
+            remaining = deadline - self._clock()
             if remaining <= 0:
                 break
             try:
@@ -514,7 +524,10 @@ class GenerateBatchingPredictor(BatchingPredictor):
         # paged decode launches against a mismatched pool would scatter into
         # wrong shapes; degrade to per-request dense generation instead
         self.fallback_dense = tuple(kv_cache.signature()[:3]) != spec
-        self._rid = 0
+        # itertools.count: request-id draws are atomic (next() is a single
+        # C-level op), so the batcher thread and any future helper threads
+        # can draw ids without a lock (thread-lint unguarded-write fix)
+        self._rid = itertools.count(1)
         super().__init__(predictor=None, max_batch_size=max_batch_size,
                          max_delay_ms=max_delay_ms, faults=faults,
                          admission=admission, breaker=breaker,
@@ -584,8 +597,7 @@ class GenerateBatchingPredictor(BatchingPredictor):
         try:
             for r in batch:
                 plen = len(r.arrays[0])
-                self._rid += 1
-                rid = ("req", self._rid)
+                rid = ("req", next(self._rid))
                 t_kv = self.tracer.now_us() if traced else 0.0
                 try:
                     cache.reserve(rid, plen + self.max_new_tokens)

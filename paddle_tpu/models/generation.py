@@ -108,6 +108,40 @@ class GenerationMixin:
 
         return sample
 
+    @staticmethod
+    def _make_slot_sampler(eos, ids_dtype):
+        """Per-SLOT sampler for the continuous-batching step programs:
+        temperature/top-k arrive as TRACED [S] arrays, not trace constants,
+        so mixed-sampler traffic runs ONE compiled program per step type
+        (they used to ride the cache key and fork programs — ROADMAP item 1).
+
+        Semantics per slot s: temps[s] <= 0 -> greedy argmax; else softmax
+        sampling at temps[s] with optional top-k truncation (top_ks[s] <= 0
+        -> no truncation). Traced top-k cannot use lax.top_k (static k), so
+        the threshold is the k-th value of a descending sort — O(V log V)
+        per slot, noise next to the model matmuls at serving vocab sizes."""
+
+        def sample(lg, key, finished, temps, top_ks):
+            lg32 = lg.astype(jnp.float32)
+            greedy_tok = jnp.argmax(lg32, axis=-1)
+            safe_t = jnp.where(temps > 0, temps, jnp.float32(1.0))
+            scaled = lg32 / safe_t[:, None]
+            vocab = scaled.shape[-1]
+            sorted_desc = -jnp.sort(-scaled, axis=-1)
+            k_idx = (jnp.clip(top_ks, 1, vocab) - 1).astype(jnp.int32)
+            kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)
+            cut = jnp.where((top_ks > 0)[:, None] & (scaled < kth),
+                            jnp.finfo(jnp.float32).min, scaled)
+            key, sub = jax.random.split(key)
+            sampled = jax.random.categorical(sub, cut, axis=-1)
+            nxt = jnp.where(temps > 0, sampled, greedy_tok).astype(ids_dtype)
+            if eos >= 0:
+                nxt = jnp.where(finished, eos, nxt)
+                finished = finished | (nxt == eos)
+            return nxt, key, finished
+
+        return sample
+
     def _runner_cache(self):
         cache = getattr(self, "_generate_cache", None)
         if cache is None:
@@ -409,7 +443,11 @@ class GenerationMixin:
         0..N-1 plus its own causal prefix. Returns [S] next-token samples
         from each chunk's LAST valid position — meaningful only for the slot
         whose chunk completes its prompt (the scheduler ignores the rest).
-        Pools are committed back to `kv_cache`."""
+        Pools are committed back to `kv_cache`.
+
+        `temperature` / `top_k` are scalars or per-slot [S] arrays and enter
+        the program as TRACED inputs (see _make_slot_sampler): requests with
+        different sampling params share the one compiled step program."""
         ids = (chunk_ids._value if isinstance(chunk_ids, Tensor)
                else jnp.asarray(chunk_ids))
         S, C = ids.shape
@@ -417,17 +455,18 @@ class GenerationMixin:
                         if kv_cache.dtype != jnp.float32 else None)
         state = self._decode_state(decode_dtype)
         ids_dtype = ids.dtype
-        greedy = not (temperature and temperature > 0)
         eos = -1 if eos_token_id is None else int(eos_token_id)
-        sample = self._make_sampler(greedy, temperature, top_k, eos, ids_dtype)
+        sample = self._make_slot_sampler(eos, ids_dtype)
+        temps = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (S,))
+        tks = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (S,))
         NB = int(block_tables.shape[1])
 
         def make_run():
-            donate = (5, 6) if self._pool_donation() else ()
+            donate = (7, 8) if self._pool_donation() else ()
 
             @functools.partial(jax.jit, donate_argnums=donate)
-            def run(raw_state, chunk, offs, lens, tables, k_pages, v_pages,
-                    key):
+            def run(raw_state, chunk, offs, lens, tables, stemps, stks,
+                    k_pages, v_pages, key):
                 offs = offs.astype(jnp.int32)
                 lens = lens.astype(jnp.int32)
                 caches = list(zip(k_pages, v_pages))
@@ -440,14 +479,14 @@ class GenerationMixin:
                     logits,
                     jnp.maximum(lens - 1, 0)[:, None, None].astype(jnp.int32),
                     axis=1)[:, 0]
-                tok, _, _ = sample(last, key, jnp.zeros((S,), bool))
+                tok, _, _ = sample(last, key, jnp.zeros((S,), bool),
+                                   stemps, stks)
                 return (tok, [kc for kc, _ in caches],
                         [vc for _, vc in caches])
 
             return run
 
-        cache_key = ("prefill_chunk", S, C, NB, kv_cache.signature(), greedy,
-                     float(temperature or 0.0), int(top_k or 0), eos,
+        cache_key = ("prefill_chunk", S, C, NB, kv_cache.signature(), eos,
                      str(ids_dtype), decode_kernel)
         run_cache = self._runner_cache()
         run = run_cache.get(cache_key)
@@ -463,7 +502,7 @@ class GenerationMixin:
                 tok, new_k, new_v = run(
                     state, ids, jnp.asarray(offsets, jnp.int32),
                     jnp.asarray(chunk_lens, jnp.int32),
-                    jnp.asarray(block_tables, jnp.int32),
+                    jnp.asarray(block_tables, jnp.int32), temps, tks,
                     tuple(kv_cache.k_pages), tuple(kv_cache.v_pages),
                     jax.random.key(seed))
                 kv_cache.commit(new_k, new_v)
@@ -512,19 +551,22 @@ class GenerationMixin:
                         if kv_cache.dtype != jnp.float32 else None)
         state = self._decode_state(decode_dtype)
         ids_dtype = tokens.dtype
-        greedy = not (temperature and temperature > 0)
         eos = -1 if eos_token_id is None else int(eos_token_id)
-        sample = self._make_sampler(greedy, temperature, top_k, eos, ids_dtype)
+        # temperature/top_k are TRACED per-slot inputs (scalars broadcast):
+        # mixed-sampler traffic shares the one compiled tick program
+        sample = self._make_slot_sampler(eos, ids_dtype)
+        temps = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (S,))
+        tks = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (S,))
         NB = int(block_tables.shape[1])
         if max_lens is None:    # no ceiling: same program, permissive values
             max_lens = jnp.asarray(lengths, jnp.int32) + jnp.int32(T)
 
         def make_run():
-            donate = (6, 7) if self._pool_donation() else ()
+            donate = (8, 9) if self._pool_donation() else ()
 
             @functools.partial(jax.jit, donate_argnums=donate)
-            def run(raw_state, tok, lens, act, lmax, tables, k_pages, v_pages,
-                    key):
+            def run(raw_state, tok, lens, act, lmax, tables, stemps, stks,
+                    k_pages, v_pages, key):
                 lens = lens.astype(jnp.int32)
                 lmax = lmax.astype(jnp.int32)
                 caches = list(zip(k_pages, v_pages))
@@ -536,7 +578,8 @@ class GenerationMixin:
                     lg, caches = self._decode_call(
                         raw_state, tok[:, None], caches, lens, decode_kernel,
                         paged_tables=tables, cache_valid=valid)
-                    nxt, key, finished = sample(lg[:, -1], key, finished)
+                    nxt, key, finished = sample(lg[:, -1], key, finished,
+                                                stemps, stks)
                     nxt = jnp.where(act, nxt, tok)   # idle slots hold
                     return (nxt, caches, lens + adv, key, finished), nxt
 
@@ -548,8 +591,7 @@ class GenerationMixin:
 
             return run
 
-        cache_key = ("decode_step", S, T, NB, kv_cache.signature(), greedy,
-                     float(temperature or 0.0), int(top_k or 0), eos,
+        cache_key = ("decode_step", S, T, NB, kv_cache.signature(), eos,
                      str(ids_dtype), decode_kernel)
         run_cache = self._runner_cache()
         run = run_cache.get(cache_key)
@@ -566,7 +608,7 @@ class GenerationMixin:
                     state, tokens, jnp.asarray(lengths, jnp.int32),
                     jnp.asarray(active, bool),
                     jnp.asarray(max_lens, jnp.int32),
-                    jnp.asarray(block_tables, jnp.int32),
+                    jnp.asarray(block_tables, jnp.int32), temps, tks,
                     tuple(kv_cache.k_pages), tuple(kv_cache.v_pages),
                     jax.random.key(seed))
                 kv_cache.commit(new_k, new_v)

@@ -139,6 +139,31 @@ def pytest_sessionfinish(session, exitstatus):
         session.exitstatus = 1
 
 
+# ----------------------------------------------------- runtime lock witness
+# Every chaos-marked test runs with the analysis/lockwitness.py witness
+# ACTIVE: all locks the serving/checkpoint runtime creates are wrapped, the
+# actual acquisition order is recorded, and an order inversion (the
+# potential deadlock the static thread lint models) fails the test — every
+# existing fault-storm leg doubles as a race detector run (ISSUE-8).
+
+
+@pytest.fixture(autouse=True)
+def _chaos_lock_witness(request):
+    if "chaos" not in request.keywords:
+        yield
+        return
+    from paddle_tpu.analysis import lockwitness
+
+    w = lockwitness.activate(lockwitness.LockWitness())
+    try:
+        yield w
+    finally:
+        lockwitness.deactivate()
+    if w.inversions:
+        pytest.fail("lock witness observed acquisition-order inversions: "
+                    f"{w.inversions}")
+
+
 # serving tests spin up batcher/server threads; one that leaks a NON-daemon
 # thread would hang the pytest process at exit, so fail the test instead
 _SERVING_TEST_HINTS = ("serving", "chaos", "resilience", "predictor")

@@ -746,3 +746,58 @@ def test_continuous_storm_exactly_one_terminal_and_pool_conserved(small_gpt):
         np.testing.assert_array_equal(out, ref)
     finally:
         gp.close()
+
+
+# --------------------------------------------------- runtime lock witness leg
+def test_chaos_lock_witness_ends_clean_and_matches_static_graph(
+        small_gpt, _chaos_lock_witness):
+    """The ISSUE-8 acceptance leg: run a fault-storm tick loop with the
+    runtime lock witness active (the conftest arms it for every chaos test)
+    and assert (a) the witness actually saw the runtime's locks, (b) zero
+    acquisition-order inversions, and (c) the observed order stays acyclic
+    even when UNIONED with the static thread-lint lock graph — a runtime
+    ordering that would deadlock against a path the tests never interleaved
+    is caught here."""
+    from paddle_tpu.analysis.threads import lock_order_graph
+
+    w = _chaos_lock_witness
+    m, prompt, ref = small_gpt
+    f = FaultInjector()
+    gp = _continuous(m, faults=f, max_slots=2, num_blocks=8)
+    try:
+        f.install("kv.reserve", error=CacheOutOfBlocks("injected"), after=1,
+                  times=1)
+        f.install("predictor.generate", error=ThreadDeath(), after=2,
+                  times=1)
+        N = 4
+        outs = [None] * N
+
+        def client(i):
+            try:
+                outs[i] = np.asarray(gp.infer(prompt, timeout=300))
+            except Exception as e:  # noqa: BLE001 - storm bookkeeping
+                outs[i] = e
+
+        ts = [threading.Thread(target=client, args=(i,)) for i in range(N)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=300)
+        assert not any(t.is_alive() for t in ts)
+        for o in outs:
+            if isinstance(o, np.ndarray):
+                np.testing.assert_array_equal(o, ref)
+        gp.kv_cache.check_conservation()
+    finally:
+        gp.close()
+
+    # (a) the wrapped runtime locks were exercised across >= 2 threads
+    assert w.acquisitions > 50, w.summary()
+    witnessed = {a for edge in w.edges for a in edge}
+    assert any("PagedKVCache" in n for n in witnessed) or any(
+        "_Request" in n for n in witnessed), sorted(witnessed)
+    # (b) zero order inversions (the conftest teardown re-asserts this for
+    # EVERY chaos leg; here it is the explicit acceptance criterion)
+    assert w.inversions == []
+    # (c) observed ∪ static acquisition order is acyclic
+    assert w.check_static(lock_order_graph()) == []

@@ -781,3 +781,84 @@ def test_manifest_records_files_meta_and_is_json(tmp_path):
     # every params/acc leaf has a chunked tensor entry
     assert any(k.startswith("params.") for k in manifest["keys"])
     assert any(k.startswith("acc.") for k in manifest["keys"])
+
+
+# ======================================== SIGTERM preemption flush (ISSUE-8)
+class _Preemptor(_LossRecorder):
+    """Delivers SIGTERM to this very process after N batches — the launch
+    controller's stop_pod seen from inside the worker."""
+
+    def __init__(self, after):
+        super().__init__()
+        self.after = after
+
+    def _on_batch_end(self, mode, step, logs=None):
+        super()._on_batch_end(mode, step, logs)
+        if mode == "train" and len(self.losses) == self.after:
+            import signal as _signal
+
+            os.kill(os.getpid(), _signal.SIGTERM)
+
+
+def test_fit_sigterm_flushes_synchronously_and_exits_elastic(tmp_path):
+    """ROADMAP item 5 satellite: SIGTERM during fit(checkpoint_dir=...)
+    triggers a final SYNCHRONOUS CheckpointManager flush at the next batch
+    boundary and raises PreemptionExit carrying ELASTIC_EXIT_CODE — the
+    contract only the legacy AutoCheckpointer spoke before. The flushed
+    step is the PREEMPTED one (5), not merely the last periodic save (4),
+    and a fresh model resumes from it bit-exactly."""
+    import signal as _signal
+
+    from paddle_tpu.distributed.fleet.elastic import ELASTIC_EXIT_CODE
+    from paddle_tpu.framework.checkpoint import PreemptionExit
+
+    ds = _fit_data()
+    base = _LossRecorder()
+    _fit_model(0).fit(ds, batch_size=4, epochs=2, shuffle=False, verbose=0,
+                      callbacks=[base])
+    assert len(base.losses) == 16
+
+    sentinel = lambda *a: None                  # noqa: E731
+    prev = _signal.signal(_signal.SIGTERM, sentinel)
+    try:
+        d = str(tmp_path / "ck")
+        pre = _Preemptor(5)
+        with pytest.raises(PreemptionExit) as ei:
+            _fit_model(0).fit(ds, batch_size=4, epochs=2, shuffle=False,
+                              verbose=0, callbacks=[pre],
+                              checkpoint_dir=d, checkpoint_every=4)
+        assert ei.value.code == ELASTIC_EXIT_CODE == 101
+        assert pre.losses == base.losses[:5]
+        # the SIGTERM flush landed step 5 synchronously (periodic was 4)
+        assert latest_step(d) == 5
+        # fit restored the previous (sentinel) handler on the way out
+        assert _signal.getsignal(_signal.SIGTERM) is sentinel
+
+        rec = _LossRecorder()
+        _fit_model(99).fit(ds, batch_size=4, epochs=2, shuffle=False,
+                           verbose=0, callbacks=[rec], checkpoint_dir=d,
+                           checkpoint_every=4)
+        assert rec.losses == base.losses[5:]    # resumes AT the preemption
+        assert latest_step(d) == 16             # graceful completion flush
+    finally:
+        _signal.signal(_signal.SIGTERM, prev)
+
+
+def test_preemption_flush_outside_main_thread_degrades_gracefully():
+    """PreemptionFlush.install() from a worker thread (signals undeliverable
+    there) must not crash fit — it degrades to poll-only mode."""
+    import threading as _threading
+
+    from paddle_tpu.framework.checkpoint import PreemptionFlush
+
+    got = {}
+
+    def off_main():
+        fl = PreemptionFlush().install()
+        got["installed"] = fl.installed
+        fl.restore()                            # no-op, must not raise
+
+    t = _threading.Thread(target=off_main)
+    t.start()
+    t.join(10)
+    assert got == {"installed": False}

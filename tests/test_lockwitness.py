@@ -1,0 +1,187 @@
+"""Runtime lock witness (ISSUE-8): acquisition-order recording, inversion
+detection, RLock re-entrancy, Eraser-style field locksets, and consistency
+checking against the static thread-lint lock graph.
+"""
+import threading
+
+import pytest
+
+from paddle_tpu.analysis.lockwitness import (
+    LockWitness,
+    _find_cycles,
+    activate,
+    active_witness,
+    deactivate,
+    make_lock,
+    make_rlock,
+)
+
+
+@pytest.fixture()
+def witness():
+    w = LockWitness()
+    activate(w)
+    try:
+        yield w
+    finally:
+        deactivate()
+
+
+def test_make_lock_is_plain_when_no_witness_active():
+    assert active_witness() is None
+    lk = make_lock("x")
+    assert type(lk) in (type(threading.Lock()),)
+    with lk:
+        pass
+
+
+def test_witness_records_edges_and_sites(witness):
+    a = make_lock("A")
+    b = make_lock("B")
+    with a:
+        with b:
+            pass
+    assert witness.acquisitions == 2
+    assert ("A", "B") in witness.edges
+    assert "test_lockwitness.py" in witness.edges[("A", "B")]
+    assert witness.inversions == []
+
+
+def test_inversion_detected_on_reversed_nesting(witness):
+    a = make_lock("A")
+    b = make_lock("B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:     # reverse order: the deadlock witnessed live
+            pass
+    assert len(witness.inversions) == 1
+    inv = witness.inversions[0]
+    assert inv["edge"] == ("B", "A")
+    assert "test_lockwitness.py" in inv["prior_site"]
+
+
+def test_rlock_reentry_records_no_self_edge(witness):
+    r = make_rlock("R")
+    with r:
+        with r:     # re-entrant: no edge, no inversion
+            pass
+    assert witness.acquisitions == 1
+    assert witness.edges == {}
+    assert witness.inversions == []
+
+
+def test_same_name_different_instances_skip_edges(witness):
+    l1 = make_lock("kv_cache.PagedKVCache._lock")
+    l2 = make_lock("kv_cache.PagedKVCache._lock")
+    with l1:
+        with l2:    # per-instance handover pattern: not an inversion
+            pass
+    assert witness.edges == {}
+    assert witness.inversions == []
+
+
+def test_cross_thread_inversion_detected(witness):
+    a, b = make_lock("A"), make_lock("B")
+    with a:
+        with b:
+            pass
+
+    def other():
+        with b:
+            with a:
+                pass
+
+    t = threading.Thread(target=other, daemon=True)
+    t.start()
+    t.join(5)
+    assert len(witness.inversions) == 1
+
+
+def test_explicit_acquire_release_tracked(witness):
+    a, b = make_lock("A"), make_lock("B")
+    assert a.acquire()
+    assert b.acquire()
+    b.release()
+    a.release()
+    assert ("A", "B") in witness.edges
+    # after release, acquiring b alone adds no edge
+    with b:
+        pass
+    assert ("B", "A") not in witness.edges
+
+
+def test_field_lockset_intersection_and_race_candidate(witness):
+    lk = make_lock("L")
+    with lk:
+        witness.note_field("Pool.pages")
+    assert witness.field_lockset("Pool.pages") == frozenset({"L"})
+
+    done = threading.Event()
+
+    def unlocked_access():
+        witness.note_field("Pool.pages")    # second thread, no lock
+        done.set()
+
+    threading.Thread(target=unlocked_access, daemon=True).start()
+    assert done.wait(5)
+    assert witness.field_lockset("Pool.pages") == frozenset()
+    races = witness.race_candidates()
+    assert races and races[0]["field"] == "Pool.pages"
+
+
+def test_check_static_flags_cycle_with_unexercised_path(witness):
+    a, b = make_lock("A"), make_lock("B")
+    with a:
+        with b:     # runtime observed A -> B only
+            pass
+    assert witness.check_static([]) == []
+    # the static pass knows a B -> A path the tests never interleaved
+    cycles = witness.check_static([("B", "A")])
+    assert cycles and set(cycles[0][:-1]) == {"A", "B"}
+
+
+def test_check_static_accepts_thread_lint_graph(witness):
+    from paddle_tpu.analysis.threads import lock_order_graph
+
+    a, b = make_lock("A"), make_lock("B")
+    with a:
+        with b:
+            pass
+    assert witness.check_static(lock_order_graph()) == []
+
+
+def test_find_cycles_helper():
+    assert _find_cycles({"a": {"b"}, "b": {"c"}}) == []
+    cyc = _find_cycles({"a": {"b"}, "b": {"a"}})
+    assert len(cyc) == 1 and set(cyc[0][:-1]) == {"a", "b"}
+    # two disjoint cycles both found
+    cyc2 = _find_cycles({"a": {"b"}, "b": {"a"}, "x": {"y"}, "y": {"x"}})
+    assert len(cyc2) == 2
+
+
+def test_summary_shape(witness):
+    a, b = make_lock("A"), make_lock("B")
+    with a:
+        with b:
+            witness.note_field("f")
+    s = witness.summary()
+    assert s["acquisitions"] == 2 and s["edges"] == 1
+    assert s["inversions"] == [] and s["race_candidates"] == []
+
+
+def test_witnessed_locks_created_during_activation_keep_reporting():
+    w = LockWitness()
+    activate(w)
+    try:
+        lk = make_lock("A")
+    finally:
+        deactivate()
+    # the wrapper survives deactivation (its objects outlive the test that
+    # created them) and keeps feeding ITS witness, harmlessly
+    with lk:
+        pass
+    assert w.acquisitions == 1
+    # but new locks made now are plain again
+    assert type(make_lock("B")) is type(threading.Lock())

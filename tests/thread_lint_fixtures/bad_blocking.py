@@ -1,0 +1,23 @@
+"""Seeded violation: blocking calls under a held lock (blocking-under-lock).
+
+A timeout-less ``Queue.get``, a ``time.sleep`` and file I/O all inside the
+critical section: every other thread touching ``_lock`` now waits on them.
+Never imported.
+"""
+import queue
+import threading
+import time
+
+
+class Sluggish:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue = queue.Queue()
+        self._t = threading.Thread(target=self._drain, daemon=True)
+
+    def _drain(self):
+        with self._lock:
+            job = self._queue.get()             # blocks forever under lock
+            time.sleep(0.5)                     # sleeps under lock
+            with open("/tmp/fixture", "w") as f:  # file I/O under lock
+                f.write(str(job))

@@ -1,0 +1,21 @@
+"""Seeded violations: raw clock read + non-daemon thread.
+
+``Drifty`` declares an injectable clock but reads ``time.time()`` directly
+(skew-driven chaos tests cannot steer it), and starts a worker without
+``daemon=True`` (a leak hangs interpreter shutdown). Never imported.
+"""
+import threading
+import time
+
+
+class Drifty:
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self._t = None
+
+    def start(self):
+        self._t = threading.Thread(target=self._tick)   # no daemon=True
+        self._t.start()
+
+    def _tick(self):
+        return time.time()          # raw clock next to the injectable one

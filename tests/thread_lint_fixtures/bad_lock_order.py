@@ -1,0 +1,27 @@
+"""Seeded violation: lock-order inversion (lock-order-cycle rule).
+
+``forward`` acquires ``_a`` then — through a method call, proving the
+interprocedural graph — ``_b``; ``backward`` nests them the other way.
+Two threads interleaving forward/backward deadlock. Never imported.
+"""
+import threading
+
+
+class TwoLocks:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.items = []
+
+    def forward(self):
+        with self._a:
+            self._grab_b()          # b acquired while a is held (indirect)
+
+    def _grab_b(self):
+        with self._b:
+            self.items.append(1)
+
+    def backward(self):
+        with self._b:
+            with self._a:           # a acquired while b is held -> cycle
+                self.items.append(2)

@@ -1,0 +1,22 @@
+"""Seeded violation: unguarded shared write (unguarded-write rule).
+
+The worker thread bumps ``counter`` with no lock held while ``snapshot``
+reads it under the class lock — the classic inconsistent lockset.
+Never imported.
+"""
+import threading
+
+
+class Racy:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counter = 0
+        self._worker = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while True:
+            self.counter += 1       # written on the worker thread, no lock
+
+    def snapshot(self):
+        with self._lock:
+            return self.counter
