@@ -15,6 +15,11 @@ One place builds the programs the CLI ``--self-check``, the bench
   serving loop launches thousands of times per second, so host-sync and
   recompile-hazard findings here are deploy blockers; their fixed
   slot/table widths are what keeps them recompile-clean by construction.
+* ``gpt_verify_step`` — the speculative-decoding verifier
+  (models/generation.py ``verify_step``): scores a fixed-width ``[S, K+1]``
+  draft chunk in one forward and runs rejection sampling in-program. Same
+  deploy-blocker standard as the decode tick — the acceptance pattern must
+  never leak into the program shape.
 
 Smoke sizes on purpose: lint findings are properties of the GRAPH, not the
 weights, and the same rules fire on a 2-layer 64-wide GPT as on 350M — so
@@ -87,9 +92,17 @@ def gpt_decode_dense_report(thresholds=None, allowlist=None):
     import jax.numpy as jnp
 
     state = model._decode_state(jnp.bfloat16)
-    return analyze(run, state, ids._value, jax.random.key(0),
+    # sampler params are TRACED [B] inputs since the fused-sampler refactor
+    # (ISSUE-10 satellite): one program serves greedy AND sampled configs,
+    # and sampling lives inside the scan body — this entry is what keeps
+    # the dense decode program host-sync-clean with no allowlist entries.
+    # Nonzero temps/top_ks here lint the SAMPLED branch of the fused math.
+    return analyze(run, state, ids._value,
+                   jnp.full((B,), 0.8, jnp.float32),
+                   jnp.full((B,), 4, jnp.int32), jax.random.key(0),
                    _name="gpt.decode.dense",
-                   _arg_labels=("state", "prompt", "rng_key"),
+                   _arg_labels=("state", "prompt", "temperatures", "top_ks",
+                                "rng_key"),
                    _thresholds=thresholds, _allowlist=allowlist)
 
 
@@ -209,6 +222,38 @@ def gpt_decode_step_report(thresholds=None, allowlist=None):
         _thresholds=thresholds, _allowlist=allowlist)
 
 
+def gpt_verify_step_report(thresholds=None, allowlist=None):
+    import jax
+
+    from .core import analyze
+
+    model, kv, tbl, ids, S, C, NEW, T, jnp = _continuous_smoke()
+    # prefill the live slot so verification runs against committed state
+    model.prefill_chunk(ids, np.zeros(S, np.int64),
+                        np.asarray([C, 0], np.int64), kv, tbl)
+    K = 3
+    chunk = np.zeros((S, K + 1), np.int64)
+    chunk[0] = np.random.RandomState(1).randint(0, 512, K + 1)
+    offs = np.asarray([C, 0], np.int64)
+    dlens = np.asarray([K, 0], np.int64)
+    act = np.asarray([True, False])
+    lmax = np.asarray([C + NEW, 0], np.int64)
+    model.verify_step(chunk, offs, dlens, act, kv, tbl, max_lens=lmax)
+    run = model.compiled_verify_step_runner(S, K + 1)
+    return analyze(
+        run, model._decode_state(jnp.bfloat16), jnp.asarray(chunk),
+        jnp.asarray(offs, jnp.int32), jnp.asarray(dlens, jnp.int32),
+        jnp.asarray(act), jnp.asarray(lmax, jnp.int32),
+        jnp.asarray(tbl, jnp.int32),
+        jnp.zeros((S,), jnp.float32), jnp.zeros((S,), jnp.int32),
+        tuple(kv.k_pages), tuple(kv.v_pages), jax.random.key(0),
+        _name="gpt.decode.paged_verify_step",
+        _arg_labels=("state", "chunk", "offsets", "draft_lens", "active",
+                     "max_lens", "tables", "temperatures", "top_ks",
+                     "k_pages", "v_pages", "rng_key"),
+        _thresholds=thresholds, _allowlist=allowlist)
+
+
 ZOO_PROGRAMS = {
     "gpt_train": gpt_train_report,
     "resnet_train": resnet_train_report,
@@ -216,6 +261,7 @@ ZOO_PROGRAMS = {
     "gpt_decode_paged": gpt_decode_paged_report,
     "gpt_prefill_chunk": gpt_prefill_chunk_report,
     "gpt_decode_step": gpt_decode_step_report,
+    "gpt_verify_step": gpt_verify_step_report,
 }
 
 

@@ -124,3 +124,13 @@ from .kv_cache import (  # noqa: E402,F401  (serving-layer paged KV cache)
     CacheOutOfBlocks,
     PagedKVCache,
 )
+
+from .speculative import (  # noqa: E402,F401  (draft/verify decoding)
+    Drafter,
+    DraftModelDrafter,
+    NGramDrafter,
+    SelfSpeculativeDrafter,
+    SpecStats,
+    make_drafter,
+    speculative_generate,
+)

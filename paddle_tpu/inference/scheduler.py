@@ -33,7 +33,13 @@ Both step programs are FIXED WIDTH (S slots, static chunk width, static
 table width, per-slot active masks), so the scheduler runs exactly two
 compiled programs forever — no shape-driven recompiles as sequences come
 and go (the `recompile-hazard` lint rule gates this by construction;
-analysis/zoo.py registers both programs).
+analysis/zoo.py registers both programs). With ``spec_k > 0`` the decode
+tick is replaced by the equally fixed-width speculative ``verify_step``
+program (ISSUE-10): up to spec_k host-drafted tokens per slot are scored
+in one prefill-shaped launch and accepted/rejected in-program, emitting
+1 + accepted tokens per slot per tick with the output distribution
+provably unchanged — still exactly two programs, still zero recompiles
+across accept/reject/admit/retire patterns.
 
 Everything the fixed-batch predictor guaranteed still holds per token-step:
 one Deadline rides HTTP -> queue -> slot and expiry anywhere reaches exactly
@@ -44,6 +50,7 @@ ServiceUnavailable instead of stranding clients.
 """
 from __future__ import annotations
 
+import collections
 import itertools
 import queue
 
@@ -54,6 +61,7 @@ from .faults import ThreadDeath
 from .kv_cache import CacheOutOfBlocks
 from .resilience import DeadlineExceeded, ServiceUnavailable
 from .serving import _PENDING, GenerateBatchingPredictor
+from .speculative import make_drafter
 
 __all__ = ["ContinuousGenerateBatchingPredictor"]
 
@@ -65,7 +73,7 @@ class _SlotSeq:
 
     __slots__ = ("req", "rid", "ids", "out_dtype", "plen", "pos", "tok",
                  "length", "generated", "table", "phase", "max_new", "order",
-                 "temperature", "top_k")
+                 "temperature", "top_k", "spec")
 
     def __init__(self, req, rid, ids, out_dtype, max_new, order):
         self.req = req
@@ -85,6 +93,11 @@ class _SlotSeq:
         # programs, so mixed-sampler slots share one compiled program
         self.temperature = float(req.temperature or 0.0)
         self.top_k = int(req.top_k or 0)
+        # per-request speculation opt-out (X-Spec header); honored only
+        # when the scheduler runs with spec_k > 0 — an opted-out slot rides
+        # the same verify program with draft_len 0 (no recompile)
+        self.spec = True if getattr(req, "spec", None) is None else bool(
+            req.spec)
 
 
 class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
@@ -113,13 +126,32 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
                          full cap).
     eos_token_id         optional early-exit token; on EOS the remainder is
                          frozen to EOS (sampler parity) and the slot retires.
+    spec_k               speculative decoding width (ISSUE-10): when > 0 the
+                         decode tick becomes one fixed-width `verify_step`
+                         launch scoring up to spec_k host-drafted tokens per
+                         slot — 1 + accepted tokens per launch, output
+                         distribution unchanged. 0 (default) keeps the plain
+                         decode_step tick.
+    drafter              'ngram' (default; prompt-lookup, host-free) |
+                         'self' (shallow-window reuse of the target model) |
+                         any inference.speculative.Drafter instance.
+    admit_policy         'fifo' (default) | 'shortest_prompt_first': free
+                         slots take the queued request with the shortest
+                         prompt (ties to the most urgent deadline, then
+                         arrival) — shorter prompts prefill in fewer chunks,
+                         so slot turnover and aggregate goodput rise under
+                         mixed-length pressure at the cost of bounded
+                         long-prompt delay (they still admit whenever they
+                         are the backlog minimum).
     """
 
     _component = "continuous"
+    supports_sampler_knobs = True   # serving.py gates per-request headers
 
     def __init__(self, model, max_slots=8, prefill_chunk=16,
                  prefill_token_budget=None, decode_steps=4, max_seq_len=None,
-                 eos_token_id=None, max_defers=32, **kwargs):
+                 eos_token_id=None, max_defers=32, spec_k=0, drafter="ngram",
+                 admit_policy="fifo", **kwargs):
         self.max_slots = int(max_slots)
         self.prefill_chunk = int(prefill_chunk)
         self.prefill_token_budget = int(prefill_token_budget
@@ -131,6 +163,23 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
         self.decode_steps = int(decode_steps)
         self.eos_token_id = (None if eos_token_id is None
                              else int(eos_token_id))
+        self.spec_k = int(spec_k)
+        if self.spec_k < 0:
+            raise ValueError("spec_k must be >= 0")
+        self._drafter = (make_drafter(drafter, model) if self.spec_k > 0
+                         else None)
+        if admit_policy not in ("fifo", "shortest_prompt_first"):
+            raise ValueError(f"unknown admit_policy {admit_policy!r} "
+                             "(fifo | shortest_prompt_first)")
+        self.admit_policy = admit_policy
+        # reorder buffer for non-FIFO admission; deque: appends/pops are
+        # atomic under the GIL (thread-lint atomic-type contract) — touched
+        # by the batcher thread and by close()
+        self._backlog: collections.deque = collections.deque()
+        # speculation accounting (host ints; written under _slot_lock, read
+        # by registry gauge scrapes from other threads)
+        self._spec_drafted = 0
+        self._spec_accepted = 0
         # per-tick RNG seed draw (atomic): sampling slots get fresh noise
         # each tick; greedy output is seed-independent (argmax)
         self._seed = itertools.count(1)
@@ -149,7 +198,7 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
             raise ValueError(f"max_seq_len {self.max_seq_len} exceeds the "
                              f"pool ({pool_tokens} tokens)")
         self.table_width = self.kv_cache.blocks_for(self.max_seq_len)
-        self._bind_scheduler_metrics()
+        self._spec_counter = self._bind_scheduler_metrics()
 
     # ------------------------------------------------------------- telemetry
     def _bind_scheduler_metrics(self):
@@ -180,6 +229,27 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
             "Prompt tokens still to prefill across in-flight slots",
             labels=("component",)).labels(self._component).set_function(
                 self._prefill_backlog)
+        # speculative decoding accounting (ISSUE-10): drafted / accepted /
+        # wasted token counters plus the derived acceptance-rate gauge —
+        # THE dial that says whether spec_k is paying for its verify width.
+        # Returned (not self-assigned) so the _spec_counter attribute write
+        # happens in __init__, before any worker thread can observe it.
+        spec_counter = reg.counter(
+            "paddle_spec_tokens_total",
+            "Speculative decoding tokens by kind: drafted (submitted to "
+            "verify), accepted (kept), wasted (drafted - accepted)",
+            labels=("component", "kind"))
+        reg.gauge(
+            "paddle_spec_acceptance_rate",
+            "Cumulative speculative acceptance rate (accepted / drafted)",
+            labels=("component",)).labels(self._component).set_function(
+                self._acceptance_rate)
+        return spec_counter
+
+    def _acceptance_rate(self):
+        with self._slot_lock:
+            d, a = self._spec_drafted, self._spec_accepted
+        return a / d if d else 0.0
 
     def _gen_timing(self, info):
         """Launch-latency histogram only: the base hook also counts
@@ -203,7 +273,7 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
 
     # ---------------------------------------------------------------- client
     def infer(self, ids, timeout=None, deadline=None, trace_id=None,
-              max_new_tokens=None, temperature=None, top_k=None):
+              max_new_tokens=None, temperature=None, top_k=None, spec=None):
         """One prompt in -> prompt + generated ids out.
 
         `max_new_tokens` (<= the server cap) asks for fewer tokens than the
@@ -215,7 +285,13 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
         greedy). They ride the step programs as traced per-slot arrays, so
         a greedy request and a temperature-0.8/top-k-40 request decode in
         the SAME tick of the SAME compiled program — mixed-sampler traffic
-        never forks step programs (recompile-sentinel-pinned in tests)."""
+        never forks step programs (recompile-sentinel-pinned in tests).
+
+        `spec` (tri-state) opts this request out of speculative decoding
+        (`spec=False`) when the scheduler runs with spec_k > 0: the slot
+        rides the same verify program with zero drafts. `spec=True` is a
+        no-op beyond the default; it cannot force speculation on a
+        scheduler configured without it."""
         req = self._make_request([np.asarray(ids)], timeout, deadline,
                                  trace_id)
         if max_new_tokens is not None:
@@ -225,6 +301,8 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
             req.temperature = float(temperature)
         if top_k is not None:
             req.top_k = int(top_k)
+        if spec is not None:
+            req.spec = bool(spec)
         return self._submit(req)
 
     def _admission_check(self, arrays):
@@ -241,7 +319,8 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
 
     def pending(self) -> int:
         """Queued + in-flight sequences (drain condition)."""
-        return self._queue.qsize() + self._phase_count(None)
+        return (self._queue.qsize() + len(self._backlog)
+                + self._phase_count(None))
 
     # ------------------------------------------------------------- tick loop
     def _loop(self):
@@ -296,8 +375,7 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
             if idx is None:
                 return
             try:
-                req = (self._queue.get(timeout=0.05) if block
-                       else self._queue.get_nowait())
+                req = self._next_request(block)
             except queue.Empty:
                 return
             block = False
@@ -334,6 +412,37 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
             if tr is not None:
                 tr.event("admitted", slot=idx, prompt_len=plen,
                          max_new=max_new)
+
+    def _next_request(self, block):
+        """One queue pop under the admit policy.
+
+        FIFO pops the arrival queue directly. shortest_prompt_first drains
+        arrivals into a reorder backlog and admits the backlog's shortest
+        prompt, tie-broken by the most urgent deadline, then arrival order
+        (deterministic). The reorder window is only ever the set of
+        requests waiting while a slot is free — a long prompt is delayed,
+        never starved: it admits the moment it is the backlog minimum."""
+        if self.admit_policy == "fifo":
+            return (self._queue.get(timeout=0.05) if block
+                    else self._queue.get_nowait())
+        while True:                 # drain arrivals into the backlog
+            try:
+                self._backlog.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        if not self._backlog:
+            if not block:
+                raise queue.Empty
+            self._backlog.append(self._queue.get(timeout=0.05))
+
+        def urgency(item):
+            pos, r = item        # backlog preserves arrival order
+            rem = (r.deadline.remaining() if r.deadline is not None
+                   else float("inf"))
+            return (len(r.arrays[0]), rem, pos)
+        _, best = min(enumerate(self._backlog), key=urgency)
+        self._backlog.remove(best)
+        return best
 
     # ----------------------------------------------------------- retirement
     def _evict_slot(self, i, s):
@@ -483,6 +592,8 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
 
     # --------------------------------------------------------------- decode
     def _decode_tick(self):
+        if self.spec_k > 0:
+            return self._verify_tick()
         with self._slot_lock:
             dec = [(i, s) for i, s in enumerate(self._slots)
                    if s is not None and s.phase == _DECODE]
@@ -531,6 +642,86 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
             s.tok = int(toks[i, -1])
             self._absorb(i, s, toks[i])
 
+    def _verify_tick(self):
+        """Speculative decode tick (spec_k > 0): draft on the host, verify
+        in ONE fixed-width `verify_step` launch across all decoding slots.
+
+        Per slot the drafted width is min(drafter proposal, SPARE width) —
+        spare = tokens still owed minus the launch's guaranteed one, so a
+        slot about to retire rides along with zero drafts instead of
+        forking a narrower program. Rollback on rejection is length
+        bookkeeping only (verify_step's contract); the KV ceiling stays
+        the reserved plen + max_new exactly like the decode tick."""
+        with self._slot_lock:
+            dec = [(i, s) for i, s in enumerate(self._slots)
+                   if s is not None and s.phase == _DECODE]
+        if not dec:
+            return
+        S, K = self.max_slots, self.spec_k
+        chunk = np.zeros((S, K + 1), np.int64)
+        offs = np.zeros(S, np.int64)
+        dlens = np.zeros(S, np.int64)
+        maxlens = np.zeros(S, np.int64)
+        active = np.zeros(S, bool)
+        temps = np.zeros(S, np.float32)
+        tks = np.zeros(S, np.int32)
+        tables = np.zeros((S, self.table_width), np.int32)
+        for i, s in dec:
+            chunk[i, 0] = s.tok
+            offs[i] = s.length
+            maxlens[i] = s.plen + s.max_new
+            active[i] = True
+            temps[i] = s.temperature
+            tks[i] = s.top_k
+            tables[i] = s.table
+            spare = s.max_new - len(s.generated) - 1
+            if s.spec and spare > 0:
+                hist = np.concatenate(
+                    [s.ids, np.asarray(s.generated, np.int64)])
+                prop = np.asarray(self._drafter.draft(hist, K),
+                                  np.int64).reshape(-1)[:K]
+                n = min(len(prop), spare)
+                if n > 0:
+                    chunk[i, 1:1 + n] = prop[:n]
+                    dlens[i] = n
+        reqs = [s.req for _, s in dec]
+        traced = self.tracer.enabled
+        t0 = self.tracer.now_us() if traced else 0.0
+        try:
+            if self._faults is not None:
+                self._faults.check("predictor.generate")
+            acc, nxt = self.model.verify_step(
+                chunk, offs, dlens, active, self.kv_cache, tables,
+                max_lens=maxlens, temperature=temps, top_k=tks,
+                decode_kernel=self.decode_kernel, seed=next(self._seed),
+                timing_hook=self._gen_timing)
+        except ThreadDeath:
+            raise
+        except Exception as e:
+            self._fail_picks(dec, e, "verify_step", t0)
+            return
+        self.breaker.record_success()
+        self.metrics.inc("verify_ticks")
+        acc = np.asarray(acc._value if hasattr(acc, "_value") else acc)
+        nxt = np.asarray(nxt._value if hasattr(nxt, "_value") else nxt)
+        drafted = int(sum(dlens[i] for i, _ in dec))
+        accepted = int(sum(acc[i] for i, _ in dec))
+        self._span_each(reqs, "verify_step", t0, self.tracer.now_us(),
+                        slots=len(dec), drafted=drafted, accepted=accepted)
+        self._spec_counter.labels(self._component, "drafted").inc(drafted)
+        self._spec_counter.labels(self._component, "accepted").inc(accepted)
+        self._spec_counter.labels(self._component,
+                                  "wasted").inc(drafted - accepted)
+        with self._slot_lock:
+            self._spec_drafted += drafted
+            self._spec_accepted += accepted
+        for i, s in dec:
+            a = int(acc[i])
+            s.length += 1 + a   # committed rows: accepted prefix + emitted
+            s.tok = int(nxt[i])
+            self._absorb(i, s, [int(t) for t in chunk[i, 1:1 + a]]
+                         + [s.tok])
+
     # ------------------------------------------------------------- lifecycle
     def _abandon_slots(self):
         """ThreadDeath path: free every slot's blocks; still-pending
@@ -554,3 +745,19 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
             self._fail(s.req, ServiceUnavailable("predictor closed",
                                                  retry_after=None))
             self._evict_slot(i, s)
+        self._drain_backlog()
+
+    def _drain_backlog(self):
+        """Backlog twin of close()'s queue drain: requests parked in the
+        admit-policy reorder buffer get a terminal outcome too."""
+        while True:
+            try:
+                r = self._backlog.popleft()
+            except IndexError:
+                break
+            self._fail(r, ServiceUnavailable("predictor closed",
+                                             retry_after=None))
+
+    def close(self):
+        super().close()
+        self._drain_backlog()

@@ -74,7 +74,7 @@ class _Request:
 
     __slots__ = ("arrays", "event", "result", "error", "deadline", "retries",
                  "defers", "t0", "trace", "enq_us", "max_new", "temperature",
-                 "top_k", "_lock", "_state")
+                 "top_k", "spec", "_lock", "_state")
 
     def __init__(self, arrays, deadline=None, trace=None):
         self.arrays = arrays
@@ -90,6 +90,7 @@ class _Request:
         self.max_new = None     # per-request token budget (continuous sched.)
         self.temperature = None  # per-request sampling (continuous sched.)
         self.top_k = None
+        self.spec = None        # tri-state speculative opt-out (continuous)
         self._lock = make_lock("serving._Request._lock")
         self._state = _PENDING
 
@@ -137,6 +138,12 @@ class BatchingPredictor:
     batch before surfacing the error, and a Supervisor restarts the batcher
     thread if it dies (clients waiting in `_await` drive the restart, so a
     dead batcher with a full queue heals without a watchdog thread)."""
+
+    # per-request sampler headers (X-Temperature/X-Top-K/X-Spec) only make
+    # sense on the continuous scheduler, whose step programs take traced
+    # per-slot sampler inputs; the whole-batch predictors run one sampler
+    # config per compiled program, so the HTTP layer 400s the headers there
+    supports_sampler_knobs = False
 
     _component = "batcher"      # prometheus `component` label value
 
@@ -805,6 +812,54 @@ class InferenceServer:
                 except ValueError:
                     return outer.default_timeout
 
+            def _sampler_knobs(self):
+                """Per-request sampler knobs over HTTP (ROADMAP item 1):
+                X-Temperature / X-Top-K / X-Spec ride the continuous
+                scheduler's traced infer(temperature=, top_k=, spec=) path
+                — no recompile, no server restart. A malformed value is a
+                client bug: ValueError -> 400 via _fail_http, never a
+                silently-applied default (unlike X-Timeout-Ms, where
+                clamping is the safe interpretation)."""
+                kw = {}
+                t = self.headers.get("X-Temperature")
+                if t is not None:
+                    try:
+                        tv = float(t)
+                    except ValueError:
+                        raise ValueError(
+                            f"malformed X-Temperature {t!r}") from None
+                    if not math.isfinite(tv) or tv < 0:
+                        raise ValueError(
+                            f"X-Temperature out of range: {t!r} "
+                            "(need a finite value >= 0)")
+                    kw["temperature"] = tv
+                k = self.headers.get("X-Top-K")
+                if k is not None:
+                    try:
+                        kv = int(k)
+                    except ValueError:
+                        raise ValueError(
+                            f"malformed X-Top-K {k!r}") from None
+                    if kv < 0:
+                        raise ValueError(
+                            f"X-Top-K out of range: {k!r} (need >= 0)")
+                    kw["top_k"] = kv
+                s = self.headers.get("X-Spec")
+                if s is not None:
+                    sv = s.strip().lower()
+                    if sv not in ("on", "off"):
+                        raise ValueError(
+                            f"malformed X-Spec {s!r} (on|off)")
+                    kw["spec"] = sv == "on"
+                if kw and not getattr(outer.generator,
+                                      "supports_sampler_knobs", False):
+                    raise ValueError(
+                        "per-request sampler headers need the continuous "
+                        "scheduler (ContinuousGenerateBatchingPredictor); "
+                        "this server's generator batches whole requests "
+                        "with a fixed sampler config")
+                return kw
+
             def do_GET(self):
                 path, _, query = self.path.partition("?")
                 if path == "/health":
@@ -852,7 +907,8 @@ class InferenceServer:
                         ids = data[data.files[0]]
                         out = outer.generator.infer(ids,
                                                     timeout=self._timeout(),
-                                                    trace_id=self._trace_id())
+                                                    trace_id=self._trace_id(),
+                                                    **self._sampler_knobs())
                         buf = io.BytesIO()
                         np.savez(buf, out0=out)
                         body = buf.getvalue()

@@ -187,6 +187,13 @@ class GenerationMixin:
         top-k truncation; eos positions freeze once hit. Returns
         [B, prompt+new] ids.
 
+        Sampling is FUSED into the compiled program (the scan body) with
+        temperature/top_k as traced inputs (_make_slot_sampler): changing
+        the sampler config re-runs the same program instead of recompiling
+        the whole prefill+scan, and there is no host round-trip between
+        logits and the sampled token (the registered `gpt_decode_dense`
+        zoo program lints host-sync-clean with no allowlist entries).
+
         `dtype`: decode compute dtype for weights + KV caches ('bfloat16'
         default — decode is weight-streaming-bound, see _decode_state; pass
         None to keep the parameters' own dtype).
@@ -208,13 +215,19 @@ class GenerationMixin:
         cache_dtype = decode_dtype or jnp.float32
         state = self._decode_state(decode_dtype)
         ids_dtype = ids.dtype  # closure must not pin the prompt array itself
-        greedy = not (temperature and temperature > 0)
         eos = -1 if eos_token_id is None else int(eos_token_id)
-        sample = self._make_sampler(greedy, temperature, top_k, eos, ids_dtype)
+        # sampler params enter as TRACED [B] inputs (the PR 8 slot-sampler
+        # math): every (greedy, temperature, top_k) config shares ONE
+        # compiled program per shape instead of forking the runner cache
+        sample = self._make_slot_sampler(eos, ids_dtype)
+        temps = jnp.broadcast_to(
+            jnp.asarray(0.0 if temperature is None else temperature,
+                        jnp.float32), (B,))
+        tks = jnp.broadcast_to(jnp.asarray(top_k or 0, jnp.int32), (B,))
 
         def make_run():
             @jax.jit
-            def run(raw_state, prompt, key):
+            def run(raw_state, prompt, stemps, stks, key):
                 # head-leading [B, Hkv, T, D]: the decode kernel's
                 # DMA-contiguous layout (ops/pallas/decode_attention.py)
                 caches = [
@@ -225,14 +238,16 @@ class GenerationMixin:
                 logits, caches = self._decode_call(
                     raw_state, prompt, caches, jnp.int32(0), decode_kernel)
                 finished = jnp.zeros((B,), bool)
-                tok0, key, finished = sample(logits[:, -1], key, finished)
+                tok0, key, finished = sample(logits[:, -1], key, finished,
+                                             stemps, stks)
 
                 def body(carry, t):
                     tok, caches, key, finished = carry
                     lg, caches = self._decode_call(
                         raw_state, tok[:, None], caches,
                         (P + t).astype(jnp.int32), decode_kernel)
-                    nxt, key, finished = sample(lg[:, -1], key, finished)
+                    nxt, key, finished = sample(lg[:, -1], key, finished,
+                                                stemps, stks)
                     return (nxt, caches, key, finished), nxt
 
                 if max_new_tokens > 1:
@@ -250,10 +265,10 @@ class GenerationMixin:
             return run
 
         # jit caches on function identity: rebuilding the closure per call
-        # would recompile prefill + the whole decode scan on every request
-        cache_key = (B, P, max_new_tokens, greedy, float(temperature or 0.0),
-                     int(top_k or 0), eos, str(ids.dtype), str(decode_dtype),
-                     decode_kernel)
+        # would recompile prefill + the whole decode scan on every request.
+        # Sampler params are traced inputs, so they are NOT in the key.
+        cache_key = (B, P, max_new_tokens, eos, str(ids.dtype),
+                     str(decode_dtype), decode_kernel)
         run_cache = self._runner_cache()
         run = run_cache.get(cache_key)
         compiled_now = run is None
@@ -266,7 +281,8 @@ class GenerationMixin:
             self._check_deadline(deadline, "dense decode launch")
             t0 = time.perf_counter()
             with RecordEvent("generate.dense"):
-                out = Tensor(run(state, ids, jax.random.key(seed)))
+                out = Tensor(run(state, ids, temps, tks,
+                                 jax.random.key(seed)))
             self._emit_timing(timing_hook, "dense", B, P, max_new_tokens,
                               compiled_now, t0)
             return out
@@ -275,9 +291,10 @@ class GenerationMixin:
                 self.train()
 
     def compiled_generate_runner(self, batch, prompt_len, max_new_tokens):
-        """The cached compiled (state, prompt, key) -> ids program for a prior
-        generate() shape, or None. Public so benches/audits can time the
-        compiled program itself without depending on the cache-key layout."""
+        """The cached compiled (state, prompt, temps, top_ks, key) -> ids
+        program for a prior generate() shape, or None. Public so
+        benches/audits can time the compiled program itself without
+        depending on the cache-key layout."""
         for k, run in (getattr(self, "_generate_cache", None) or {}).items():
             if k[:3] == (batch, prompt_len, max_new_tokens):
                 return run
@@ -619,6 +636,192 @@ class GenerationMixin:
             if was_training:
                 self.train()
 
+    def verify_step(self, chunk_ids, offsets, draft_lens, active, kv_cache,
+                    block_tables, max_lens=None, temperature=0.0, top_k=0,
+                    seed=0, decode_kernel="pallas", timing_hook=None):
+        """Speculative draft verification over the paged pool (fixed width).
+
+        One launch scores K drafted tokens per slot in a SINGLE forward
+        through the same split-KV paged attention `prefill_chunk` uses (the
+        chunk is a prefill-shaped call at per-slot offsets) and runs the
+        Leviathan-et-al. rejection sampler entirely inside the traced
+        program — no logits ever reach the host.
+
+        chunk_ids:  [S, K+1] — position 0 is the slot's current input token
+                    (last sampled, KV not yet written: the decode_step
+                    convention); positions 1..K are its drafted tokens
+                    (zeros past draft_lens).
+        offsets:    [S] cache rows present per slot (the row position 0
+                    writes).
+        draft_lens: [S] valid drafts per slot; 0 degrades the slot to a
+                    plain one-token decode THROUGH THE SAME PROGRAM, so
+                    draft droughts and per-request spec-off never recompile.
+        active:     [S] slot mask (idle slots write nothing, outputs held).
+        max_lens:   [S] per-slot KV write ceiling (decode_step semantics):
+                    rows >= max_lens are dropped by the OOB-scatter trick,
+                    so over-speculation near a sequence's reserved budget
+                    can never scatter into the table's pad page.
+
+        Acceptance per slot, through the SAME traced temperature/top-k
+        transform as _make_slot_sampler (temps <= 0 -> greedy): draft j is
+        accepted iff every earlier draft was and — greedy — it equals the
+        target argmax, or — sampled — u_j < p(d_j) under the target's
+        (temperature/top-k-truncated) distribution. Our drafters are
+        deterministic, so the draft distribution is a point mass and the
+        paper's min(1, p/q) acceptance reduces to p(d_j). The token emitted
+        after the accepted prefix is the corrected residual: the target
+        distribution at the rejection position with the rejected draft
+        masked out (exactly the renormalized max(p - q, 0) residual for a
+        point-mass q — and in the greedy limit simply the argmax), or the
+        bonus-position sample when every draft accepted. The output
+        distribution is therefore EXACTLY the target model's — speculation
+        changes latency, never the law of the tokens.
+
+        Returns ([S] accepted_counts int32 in 0..K, [S] next tokens). KV
+        rollback is length bookkeeping ONLY: the caller commits
+        offsets + 1 + accepted rows. Rows beyond that hold rejected-draft
+        KV, but every verify launch writes its FULL K+1-wide window, so the
+        next launch for the slot overwrites the garbage before any
+        in-budget position can attend to it — no block copies, ever."""
+        ids = (chunk_ids._value if isinstance(chunk_ids, Tensor)
+               else jnp.asarray(chunk_ids))
+        S, W = ids.shape
+        if W < 2:
+            raise ValueError("verify_step needs at least one draft position "
+                             f"(chunk width {W} = current token + K drafts)")
+        K = W - 1
+        decode_dtype = (jnp.dtype(kv_cache.dtype)
+                        if kv_cache.dtype != jnp.float32 else None)
+        state = self._decode_state(decode_dtype)
+        ids_dtype = ids.dtype
+        temps = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (S,))
+        tks = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (S,))
+        NB = int(block_tables.shape[1])
+        if max_lens is None:    # no ceiling: same program, permissive values
+            max_lens = jnp.asarray(offsets, jnp.int32) + jnp.int32(W)
+
+        def make_run():
+            donate = (9, 10) if self._pool_donation() else ()
+
+            @functools.partial(jax.jit, donate_argnums=donate)
+            def run(raw_state, chunk, offs, dlens, act, lmax, tables,
+                    stemps, stks, k_pages, v_pages, key):
+                offs = offs.astype(jnp.int32)
+                dlens = dlens.astype(jnp.int32)
+                lmax = lmax.astype(jnp.int32)
+                caches = list(zip(k_pages, v_pages))
+                pos = jnp.arange(W, dtype=jnp.int32)[None, :]
+                # the FULL chunk width writes (under the ceiling) — this is
+                # what makes rollback pure bookkeeping: garbage rows from a
+                # prior over-speculation sit inside the next launch's write
+                # window and are overwritten before they become attendable
+                valid = act[:, None] & ((offs[:, None] + pos) < lmax[:, None])
+                logits, caches = self._decode_call(
+                    raw_state, chunk, caches, offs, decode_kernel,
+                    paged_tables=tables, cache_valid=valid)
+                lg32 = logits.astype(jnp.float32)            # [S, W, V]
+                vocab = lg32.shape[-1]
+                # per-POSITION temperature/top-k transform — the same math
+                # as _make_slot_sampler, broadcast over the chunk axis, so
+                # the verified distribution is the serving sampler's
+                safe_t = jnp.where(stemps > 0, stemps, jnp.float32(1.0))
+                scaled = lg32 / safe_t[:, None, None]
+                sorted_desc = -jnp.sort(-scaled, axis=-1)
+                k_idx = (jnp.clip(stks, 1, vocab) - 1).astype(jnp.int32)
+                kth = jnp.take_along_axis(
+                    sorted_desc,
+                    jnp.broadcast_to(k_idx[:, None, None], (S, W, 1)),
+                    axis=-1)
+                cut = jnp.where((stks > 0)[:, None, None] & (scaled < kth),
+                                jnp.finfo(jnp.float32).min, scaled)
+                probs = jax.nn.softmax(cut, axis=-1)
+                drafts = chunk[:, 1:].astype(jnp.int32)      # [S, K]
+                p_draft = jnp.take_along_axis(
+                    probs[:, :K, :], drafts[..., None], axis=-1)[..., 0]
+                greedy_ok = drafts == jnp.argmax(lg32[:, :K, :], axis=-1)
+                key, ku, ks = jax.random.split(key, 3)
+                u = jax.random.uniform(ku, (S, K), jnp.float32)
+                acc = jnp.where(stemps[:, None] > 0, u < p_draft, greedy_ok)
+                live = (jnp.arange(K, dtype=jnp.int32)[None, :]
+                        < dlens[:, None])
+                acc = acc & live & act[:, None]
+                prefix = jnp.cumprod(acc.astype(jnp.int32), axis=1)
+                accepted = jnp.sum(prefix, axis=1)           # [S] in 0..K
+                # logits at the accept point: position `accepted` saw the
+                # accepted prefix as input, so its distribution is the
+                # target's next-token law after those tokens
+                nxt_lg = jnp.take_along_axis(
+                    cut, accepted[:, None, None], axis=1)[:, 0]   # [S, V]
+                # residual correction on a REAL rejection: zero out the
+                # rejected draft token (for a point-mass draft distribution
+                # the residual max(p - q, 0) is exactly p with p(d) removed,
+                # renormalized — categorical over masked logits does that)
+                rejected = accepted < dlens
+                rej_tok = jnp.take_along_axis(
+                    drafts, jnp.clip(accepted, 0, K - 1)[:, None],
+                    axis=1)[:, 0]
+                res_mask = (rejected[:, None]
+                            & (jnp.arange(vocab, dtype=jnp.int32)[None, :]
+                               == rej_tok[:, None]))
+                nxt_lg = jnp.where(res_mask, jnp.finfo(jnp.float32).min,
+                                   nxt_lg)
+                sampled = jax.random.categorical(ks, nxt_lg, axis=-1)
+                nxt = jnp.where(stemps > 0, sampled,
+                                jnp.argmax(nxt_lg, axis=-1)).astype(ids_dtype)
+                nxt = jnp.where(act, nxt, chunk[:, 0])   # idle slots hold
+                accepted = jnp.where(act, accepted, 0)
+                return (accepted, nxt, [kc for kc, _ in caches],
+                        [vc for _, vc in caches])
+
+            return run
+
+        cache_key = ("verify_step", S, W, NB, kv_cache.signature(),
+                     str(ids_dtype), decode_kernel)
+        run_cache = self._runner_cache()
+        run = run_cache.get(cache_key)
+        compiled_now = run is None
+        if run is None:
+            run = run_cache[cache_key] = make_run()
+
+        was_training = self.training
+        self.eval()
+        try:
+            t0 = time.perf_counter()
+            with RecordEvent("generate.verify_step"):
+                accepted, nxt, new_k, new_v = run(
+                    state, ids, jnp.asarray(offsets, jnp.int32),
+                    jnp.asarray(draft_lens, jnp.int32),
+                    jnp.asarray(active, bool),
+                    jnp.asarray(max_lens, jnp.int32),
+                    jnp.asarray(block_tables, jnp.int32), temps, tks,
+                    tuple(kv_cache.k_pages), tuple(kv_cache.v_pages),
+                    jax.random.key(seed))
+                kv_cache.commit(new_k, new_v)
+            self._emit_timing(timing_hook, "verify_step", S, W, 1,
+                              compiled_now, t0)
+            return Tensor(accepted), Tensor(nxt)
+        finally:
+            if was_training:
+                self.train()
+
+    def generate_speculative(self, input_ids, max_new_tokens=32, spec_k=4,
+                             drafter="ngram", temperature=0.0, top_k=0,
+                             eos_token_id=None, seed=0, dtype="bfloat16",
+                             decode_kernel="pallas", kv_cache=None,
+                             stats=None):
+        """Single-stream speculative decoding: draft K tokens on the host,
+        verify them in ONE `verify_step` launch — the b1 fast path. Same
+        return shape/semantics as `generate()` (prompt + new ids, EOS
+        freeze) with provably the same output distribution; see
+        inference/speculative.py for drafters and the driver."""
+        from ..inference.speculative import speculative_generate
+
+        return speculative_generate(
+            self, input_ids, max_new_tokens=max_new_tokens, spec_k=spec_k,
+            drafter=drafter, temperature=temperature, top_k=top_k,
+            eos_token_id=eos_token_id, seed=seed, dtype=dtype,
+            decode_kernel=decode_kernel, kv_cache=kv_cache, stats=stats)
+
     def compiled_prefill_chunk_runner(self, slots, chunk):
         """The cached compiled prefill-chunk program
         (state, chunk, offsets, lens, tables, k_pages, v_pages, key) -> tok
@@ -635,5 +838,15 @@ class GenerationMixin:
         for a prior decode_step() shape, or None."""
         for k, run in (getattr(self, "_generate_cache", None) or {}).items():
             if k[:3] == ("decode_step", slots, steps):
+                return run
+        return None
+
+    def compiled_verify_step_runner(self, slots, width):
+        """The cached compiled speculative verify program (state, chunk,
+        offsets, draft_lens, active, max_lens, tables, temps, top_ks,
+        k_pages, v_pages, key) -> (accepted, next) for a prior
+        verify_step() shape, or None. `width` is the chunk width K+1."""
+        for k, run in (getattr(self, "_generate_cache", None) or {}).items():
+            if k[:3] == ("verify_step", slots, width):
                 return run
         return None

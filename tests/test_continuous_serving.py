@@ -364,3 +364,257 @@ def test_per_slot_sampler_isolation_model_level(small_gpt):
     tk_b, toks_b = run(1.5, 4)
     assert tk_a[0] == tk_b[0]                       # greedy prefill sample
     np.testing.assert_array_equal(toks_a[0], toks_b[0])   # greedy decode
+
+
+# ------------------------------------------- speculative decoding (ISSUE-10)
+def _storm(gp, prompts, kwargs=None):
+    """Submit all prompts concurrently; return outputs in order."""
+    kwargs = kwargs or [{}] * len(prompts)
+    outs = [None] * len(prompts)
+
+    def client(i):
+        outs[i] = np.asarray(gp.infer(prompts[i], timeout=300, **kwargs[i]))
+
+    ts = [threading.Thread(target=client, args=(i,))
+          for i in range(len(prompts))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=300)
+    assert not any(t.is_alive() for t in ts)
+    return outs
+
+
+def test_spec_scheduler_parity_spec_on_vs_off(small_gpt):
+    """Speculation is a THROUGHPUT knob, never a token change: the spec_k>0
+    scheduler (verify_step ticks, n-gram drafts) emits exactly the tokens
+    the spec_k=0 scheduler (decode_step ticks) emits for the same greedy
+    traffic. Compared paged-vs-paged on purpose: dense and paged attention
+    can near-tie differently at f32 on smoke models, and that pre-existing
+    property must not be chalked up to speculation."""
+    m = small_gpt
+    rng = np.random.default_rng(23)
+    plens = [3, 4, 7, 13, 5, 9]
+    # repetitive tails make the n-gram drafter actually propose
+    prompts = [np.tile(rng.integers(0, 160, max(2, n // 2)), 8)[:n]
+               .astype("int64") for n in plens]
+
+    gp_off = _make(m)
+    try:
+        refs = _storm(gp_off, prompts)
+    finally:
+        gp_off.close()
+
+    gp = _make(m, spec_k=3)
+    try:
+        outs = _storm(gp, prompts)
+        for i, (out, ref) in enumerate(zip(outs, refs)):
+            np.testing.assert_array_equal(out, ref, err_msg=f"stream {i}")
+        snap = gp.metrics.snapshot()
+        assert snap["admitted_seqs"] == snap["retired_seqs"] == len(prompts)
+        assert gp.metrics.get("verify_ticks") >= 1
+        assert gp.kv_cache.blocks_in_use == 0
+        gp.kv_cache.check_conservation()
+        # acceptance accounting is live and exported
+        assert gp._spec_drafted >= gp._spec_accepted >= 0
+        text = render_prometheus(gp.metrics.registry)
+        assert "paddle_spec_tokens_total" in text
+        assert "paddle_spec_acceptance_rate" in text
+        # the fixed-width contract, scheduler edition: every admit/retire/
+        # accept pattern above rode ONE verify program at this (S, W)
+        verify = [k for k in m._generate_cache if k[0] == "verify_step"
+                  and k[1] == gp.max_slots]
+        assert len(verify) == 1, verify
+    finally:
+        gp.close()
+
+
+def test_spec_request_opt_out_and_sampled_stay_in_vocab(small_gpt):
+    """`spec=False` opts a request out (zero drafts, same verify program);
+    sampled requests ride speculation and stay in-vocab."""
+    m = small_gpt
+    rng = np.random.default_rng(29)
+    prompt = np.tile(rng.integers(0, 160, 4), 3)[:10].astype("int64")
+
+    gp_off = _make(m)
+    try:
+        ref = np.asarray(gp_off.infer(prompt, timeout=300))
+    finally:
+        gp_off.close()
+
+    gp = _make(m, spec_k=3)
+    try:
+        out_optout = np.asarray(gp.infer(prompt, timeout=300, spec=False))
+        np.testing.assert_array_equal(out_optout, ref)
+        sampled = np.asarray(gp.infer(prompt, timeout=300,
+                                      temperature=0.9, top_k=7))
+        assert sampled.shape == ref.shape
+        assert (sampled >= 0).all() and (sampled < 160).all()
+        assert gp.kv_cache.blocks_in_use == 0
+    finally:
+        gp.close()
+
+
+def test_spec_and_admit_policy_knob_validation(small_gpt):
+    with pytest.raises(ValueError):
+        _make(small_gpt, spec_k=-1)
+    with pytest.raises(ValueError):
+        _make(small_gpt, admit_policy="longest_prompt_first")
+    with pytest.raises(ValueError):
+        _make(small_gpt, spec_k=2, drafter="markov")
+
+
+def test_admit_policy_shortest_prompt_first_parity(small_gpt):
+    """shortest_prompt_first reorders ADMISSION only: under slot pressure
+    every request still completes token-identical to dense, conservation
+    holds, and the backlog drains to zero."""
+    m = small_gpt
+    rng = np.random.default_rng(31)
+    plens = [13, 3, 9, 4, 11, 5, 7, 6]
+    prompts = [rng.integers(0, 160, n).astype("int64") for n in plens]
+    refs = [_dense_ref(m, p, 6) for p in prompts]
+    gp = _make(m, max_slots=2, admit_policy="shortest_prompt_first")
+    try:
+        outs = _storm(gp, prompts)
+        for i, (out, ref) in enumerate(zip(outs, refs)):
+            np.testing.assert_array_equal(out, ref, err_msg=f"stream {i}")
+        snap = gp.metrics.snapshot()
+        assert snap["admitted_seqs"] == snap["retired_seqs"] == len(prompts)
+        assert gp.pending() == 0
+        assert gp.kv_cache.blocks_in_use == 0
+        gp.kv_cache.check_conservation()
+    finally:
+        gp.close()
+
+
+@pytest.mark.chaos
+def test_chaos_shortest_prompt_first_spec_conservation(small_gpt):
+    """Chaos leg: speculation + shortest_prompt_first under injected decode
+    faults — every request reaches exactly one terminal outcome and the
+    pool conserves (the ISSUE-10 scheduler paths under the lock witness)."""
+    from paddle_tpu.inference.faults import FaultInjector
+    from paddle_tpu.inference.resilience import Rejected, ServiceUnavailable
+
+    m = small_gpt
+    rng = np.random.default_rng(37)
+    plens = [5, 3, 9, 4, 7, 6]
+    prompts = [np.tile(rng.integers(0, 160, max(2, n // 2)), 8)[:n]
+               .astype("int64") for n in plens]
+    f = FaultInjector()
+    gp = _make(m, max_slots=2, spec_k=2,
+               admit_policy="shortest_prompt_first", faults=f,
+               max_retries=2)
+    served, failed = [], []
+    lock = threading.Lock()
+    try:
+        f.install("predictor.generate", error=RuntimeError("chaos"),
+                  times=2)
+
+        def client(i):
+            try:
+                out = np.asarray(gp.infer(prompts[i], timeout=300))
+                with lock:
+                    served.append((i, out))
+            except (Rejected, ServiceUnavailable, RuntimeError,
+                    TimeoutError) as e:
+                with lock:
+                    failed.append((i, e))
+
+        ts = [threading.Thread(target=client, args=(i,))
+              for i in range(len(prompts))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=300)
+        assert not any(t.is_alive() for t in ts)
+        assert len(served) + len(failed) == len(prompts)
+        for i, out in served:
+            assert out.shape == (len(prompts[i]) + 6,)
+            np.testing.assert_array_equal(out[:len(prompts[i])], prompts[i])
+        assert gp.kv_cache.blocks_in_use == 0
+        gp.kv_cache.check_conservation()
+    finally:
+        gp.close()
+
+
+# --------------------------------------- sampler headers on /generate (HTTP)
+def test_server_sampler_headers_roundtrip(small_gpt):
+    """X-Temperature / X-Top-K / X-Spec ride /generate into the continuous
+    scheduler's traced per-request knobs; malformed values are client bugs
+    and come back 400, not silently-defaulted."""
+    from paddle_tpu.inference.serving import InferenceServer
+
+    m = small_gpt
+    rng = np.random.default_rng(41)
+    prompt = rng.integers(0, 160, 5).astype("int64")
+    ref = _dense_ref(m, prompt, 6)
+    gp = _make(m)
+    srv = InferenceServer(None, batching=False, generator=gp).start()
+    base = f"http://127.0.0.1:{srv.port}"
+
+    def post(headers):
+        buf = io.BytesIO()
+        np.savez(buf, ids=prompt)
+        req = urllib.request.Request(base + "/generate", data=buf.getvalue(),
+                                     headers=headers)
+        r = urllib.request.urlopen(req, timeout=120)
+        return r.status, np.load(io.BytesIO(r.read()))["out0"]
+
+    try:
+        # explicit greedy knobs: same tokens as the dense reference
+        status, out = post({"X-Temperature": "0.0", "X-Top-K": "0",
+                            "X-Spec": "off"})
+        assert status == 200
+        np.testing.assert_array_equal(out, ref)
+        # sampled: valid knobs accepted, output in-vocab
+        status, out = post({"X-Temperature": "0.9", "X-Top-K": "5"})
+        assert status == 200
+        assert out.shape == ref.shape
+        assert (out >= 0).all() and (out < 160).all()
+        # malformed values: one 400 per knob, each with the offending value
+        for hdrs in ({"X-Temperature": "hot"},
+                     {"X-Temperature": "-0.5"},
+                     {"X-Temperature": "inf"},
+                     {"X-Top-K": "-3"},
+                     {"X-Top-K": "2.5"},
+                     {"X-Spec": "maybe"}):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                post(hdrs)
+            assert ei.value.code == 400, hdrs
+        srv.stop(drain_timeout=10)
+    finally:
+        srv.stop(drain_timeout=2)
+
+
+def test_sampler_headers_rejected_on_fixed_batch_generator(small_gpt):
+    """The fixed-batch generator decodes whole batches with one sampler
+    config — per-request knobs would silently apply to batchmates, so the
+    server refuses them (400) instead of guessing."""
+    from paddle_tpu.inference.serving import (
+        GenerateBatchingPredictor, InferenceServer,
+    )
+
+    m = small_gpt
+    gp = GenerateBatchingPredictor(m, max_batch_size=2, max_delay_ms=1,
+                                   max_new_tokens=6, decode_kernel="xla",
+                                   block_size=8, num_blocks=32)
+    srv = InferenceServer(None, batching=False, generator=gp).start()
+    base = f"http://127.0.0.1:{srv.port}"
+    prompt = np.arange(5, dtype=np.int64)
+    try:
+        buf = io.BytesIO()
+        np.savez(buf, ids=prompt)
+        req = urllib.request.Request(base + "/generate", data=buf.getvalue(),
+                                     headers={"X-Temperature": "0.7"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=120)
+        assert ei.value.code == 400
+        # headerless requests still serve normally on the same generator
+        req2 = urllib.request.Request(base + "/generate",
+                                      data=buf.getvalue())
+        r = urllib.request.urlopen(req2, timeout=120)
+        assert r.status == 200
+        srv.stop(drain_timeout=10)
+    finally:
+        srv.stop(drain_timeout=2)
+        gp.close()

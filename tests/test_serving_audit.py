@@ -141,6 +141,56 @@ def test_continuous_bench_wires_fields_and_per_request_budgets():
     assert "useful_tokens" in src
 
 
+def test_speculative_fields_gate_and_audits():
+    """ISSUE-10 acceptance wiring: the speculative_decode section derives
+    `speedup_vs_baseline` from useful b1 tok/s and gates it at 2x, lifts
+    acceptance/waste from the oracle and n-gram legs, and audits program-
+    cache growth across accept patterns to zero."""
+    out = {"baseline_tokens_per_sec": 500.0,
+           "spec_tokens_per_sec": 1250.0,
+           "oracle_stats": {"acceptance_rate": 1.0, "wasted": 0},
+           "ngram_stats": {"acceptance_rate": 0.62},
+           "programs_warm": 3, "programs_after": 3}
+    bench.speculative_decode_fields(out)
+    assert out["speedup_vs_baseline"] == pytest.approx(2.5)
+    assert out["audit"] == "ok"
+    assert out["acceptance_rate"] == pytest.approx(1.0)
+    assert out["wasted_tokens"] == 0
+    assert out["ngram_acceptance_rate"] == pytest.approx(0.62)
+    assert out["recompile_audit"] == "ok"
+
+
+def test_speculative_fields_flag_under_2x_and_recompiles():
+    out = {"baseline_tokens_per_sec": 600.0,
+           "spec_tokens_per_sec": 900.0,
+           "programs_warm": 3, "programs_after": 5}
+    bench.speculative_decode_fields(out)
+    assert out["speedup_vs_baseline"] == pytest.approx(1.5)
+    assert out["audit"] == "under-2x"
+    assert out["recompile_audit"] == "recompiled-2"
+
+
+def test_speculative_fields_skip_missing_sections():
+    out = {"spec_tokens_per_sec": 900.0}          # baseline leg absent
+    bench.speculative_decode_fields(out)
+    assert "speedup_vs_baseline" not in out and "audit" not in out
+    assert "recompile_audit" not in out and "acceptance_rate" not in out
+
+
+def test_speculative_bench_wires_fields_and_recompile_audit():
+    """Source-level pin: bench_speculative_decode must time the draft/
+    verify driver against the per-token decode_step baseline over ONE
+    shared pool, watch the model's program cache for accept-pattern
+    recompiles, and route through speculative_decode_fields."""
+    import inspect
+
+    src = inspect.getsource(bench.bench_speculative_decode)
+    assert "speculative_decode_fields(" in src
+    assert "speculative_generate(" in src
+    assert "_generate_cache" in src
+    assert "decode_step(" in src
+
+
 def test_decode_attention_bench_reports_vs_baseline():
     """The decode_attention sub-bench must report the Pallas-vs-XLA ratio
     under the contract key `vs_baseline` for every shape entry."""

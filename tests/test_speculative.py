@@ -1,0 +1,332 @@
+"""Speculative decoding (ISSUE-10): drafters, the draft/verify driver, and
+the distribution-correctness contract.
+
+The load-bearing pins:
+
+* greedy speculative output is TOKEN-IDENTICAL to dense `generate()` for
+  every drafter (acceptance only changes the launch count, never a token);
+* the sampled path is distribution-exact: the first-token law out of
+  `verify_step` (accept OR masked-residual resample) chi-square-matches the
+  target model's own cut-softmax law, and so does the fused dense sampler;
+* the accept/reject pattern never leaks into a program shape — one
+  verify_step program serves every drafter, seed, temperature and
+  acceptance outcome at a given (S, W).
+
+Parity vs the CONTINUOUS scheduler is pinned in test_continuous_serving.py
+as spec-on vs spec-off (paged vs paged): dense and paged attention sum in
+different orders, and tiny smoke models can near-tie at f32 — a
+pre-existing property of the decode paths, not of speculation.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.speculative import (
+    DraftModelDrafter,
+    NGramDrafter,
+    SelfSpeculativeDrafter,
+    SpecStats,
+    make_drafter,
+    speculative_generate,
+)
+
+
+@pytest.fixture(scope="module")
+def small_gpt():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    with paddle.utils.unique_name.guard():
+        paddle.seed(11)
+        m = GPTForCausalLM(GPTConfig(vocab_size=160, hidden_size=64,
+                                     num_layers=2, num_heads=4,
+                                     num_kv_heads=2, max_position=96,
+                                     dropout=0.0))
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def tiny_vocab_gpt():
+    """Tiny vocab so a few hundred seeded draws resolve the full
+    distribution (chi-square tests)."""
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    with paddle.utils.unique_name.guard():
+        paddle.seed(5)
+        m = GPTForCausalLM(GPTConfig(vocab_size=24, hidden_size=32,
+                                     num_layers=1, num_heads=2,
+                                     max_position=32, dropout=0.0))
+    m.eval()
+    return m
+
+
+def _dense_ref(m, prompt, max_new, eos=None):
+    return np.asarray(m.generate(
+        paddle.to_tensor(np.asarray(prompt)[None]), max_new_tokens=max_new,
+        dtype=None, decode_kernel="xla", eos_token_id=eos)._value)[0]
+
+
+def _spec(m, prompt, max_new, **kw):
+    kw.setdefault("spec_k", 4)
+    kw.setdefault("dtype", None)
+    kw.setdefault("decode_kernel", "xla")
+    return np.asarray(speculative_generate(m, np.asarray(prompt), max_new,
+                                           **kw))
+
+
+class ReplayDrafter:
+    """Oracle: replays a recorded continuation — acceptance 1.0 against the
+    chain it was recorded from."""
+
+    def __init__(self, plen, continuation):
+        self.plen = plen
+        self.cont = np.asarray(continuation, np.int64)
+
+    def draft(self, history, k):
+        pos = len(history) - self.plen
+        return self.cont[pos:pos + int(k)]
+
+
+# --------------------------------------------------------------- drafters
+def test_ngram_drafter_proposes_most_recent_longest_match():
+    d = NGramDrafter(max_n=3, min_n=1)
+    #         0  1  2  3  4  5  6  7  8
+    h = np.array([7, 8, 9, 1, 7, 8, 9, 2, 9], np.int64)
+    # suffix 1-gram [9] matched at its most recent earlier site (index 6):
+    # the 2 that followed it is the proposal, not the 1 after index 2
+    np.testing.assert_array_equal(d.draft(h, 2), [2, 9])
+    # longer suffixes win: history ending in the 3-gram [7, 8, 9] proposes
+    # what followed its earlier occurrence
+    h2 = np.array([7, 8, 9, 1, 5, 7, 8, 9], np.int64)
+    np.testing.assert_array_equal(d.draft(h2, 3), [1, 5, 7])
+    # no earlier occurrence of any suffix n-gram -> empty (driver degrades
+    # to plain decode through the same program)
+    assert len(d.draft(np.array([1, 2, 3], np.int64), 4)) == 0
+    assert len(d.draft(h, 0)) == 0
+
+
+def test_ngram_drafter_validates_orders():
+    with pytest.raises(ValueError):
+        NGramDrafter(max_n=2, min_n=3)
+    with pytest.raises(ValueError):
+        NGramDrafter(max_n=1, min_n=0)
+
+
+def test_make_drafter_resolution(small_gpt):
+    assert isinstance(make_drafter("ngram"), NGramDrafter)
+    assert isinstance(make_drafter(None), NGramDrafter)
+    assert isinstance(make_drafter("self", small_gpt),
+                      SelfSpeculativeDrafter)
+    d = NGramDrafter()
+    assert make_drafter(d) is d
+    with pytest.raises(ValueError):
+        make_drafter("self")            # needs the target model
+    with pytest.raises(ValueError):
+        make_drafter("markov")
+    with pytest.raises(ValueError):
+        make_drafter(object())
+
+
+def test_draft_model_drafter_fixed_window(small_gpt):
+    d = DraftModelDrafter(small_gpt, window=4, dtype=None,
+                          decode_kernel="xla")
+    # shorter than the window: no proposal rather than a new program shape
+    assert len(d.draft(np.array([1, 2, 3], np.int64), 4)) == 0
+    h = np.arange(10, dtype=np.int64) % 160
+    prop = d.draft(h, 3)
+    assert len(prop) == 3
+    # proposals are the draft model's greedy continuation of the window
+    ref = _dense_ref(small_gpt, h[-4:], 3)[4:]
+    np.testing.assert_array_equal(prop, ref)
+
+
+# ------------------------------------------------- greedy identity vs dense
+def test_greedy_identity_vs_dense_all_drafters(small_gpt):
+    """THE speculative contract: greedy output token-identical to dense
+    generate() no matter who drafts or how well."""
+    m = small_gpt
+    rng = np.random.default_rng(3)
+    random_p = rng.integers(0, 160, 9).astype(np.int64)
+    rep_p = np.tile(np.array([4, 17, 52], np.int64), 4)[:10]
+    for prompt in (random_p, rep_p):
+        ref = _dense_ref(m, prompt, 12)
+        for drafter in ("ngram",
+                        SelfSpeculativeDrafter(m, window=4, dtype=None,
+                                               decode_kernel="xla")):
+            got = _spec(m, prompt, 12, drafter=drafter)
+            np.testing.assert_array_equal(got, ref)
+
+
+def test_oracle_drafter_accepts_everything(small_gpt):
+    m = small_gpt
+    prompt = np.arange(8, dtype=np.int64) * 3 % 160
+    ref = _dense_ref(m, prompt, 15)
+    st = SpecStats()
+    got = _spec(m, prompt, 15, drafter=ReplayDrafter(8, ref[8:]), stats=st)
+    np.testing.assert_array_equal(got, ref)
+    assert st.acceptance_rate == 1.0
+    assert st.wasted == 0
+    assert st.emitted == 15
+    # launch amortization is the whole point: far fewer than one per token
+    assert st.launches <= 1 + (15 + 4) // 5
+
+
+def test_eos_freezes_remainder_like_dense(small_gpt):
+    m = small_gpt
+    prompt = np.array([3, 1, 4, 1, 5, 9], np.int64)
+    probe = _dense_ref(m, prompt, 10)
+    eos = int(probe[6 + 3])             # forces a mid-run EOS
+    ref = _dense_ref(m, prompt, 10, eos=eos)
+    got = _spec(m, prompt, 10, eos_token_id=eos)
+    np.testing.assert_array_equal(got, ref)
+    assert (ref[6 + 4:] == eos).all()   # the freeze actually triggered
+
+
+def test_batched_singleton_shape_and_batch_rejected(small_gpt):
+    m = small_gpt
+    prompt = np.array([[5, 6, 7, 8]], np.int64)
+    got = _spec(m, prompt, 6)
+    assert got.shape == (1, 10)
+    np.testing.assert_array_equal(got, _dense_ref(m, prompt[0], 6)[None])
+    with pytest.raises(ValueError):
+        _spec(m, np.zeros((2, 4), np.int64), 6)
+    with pytest.raises(ValueError):
+        _spec(m, prompt, 6, spec_k=0)
+
+
+def test_spec_stats_accounting_consistent(small_gpt):
+    m = small_gpt
+    st = SpecStats()
+    out = _spec(m, np.tile(np.array([9, 2], np.int64), 5), 14, stats=st)
+    assert st.emitted == 14 == len(out) - 10
+    assert 0 <= st.accepted <= st.drafted
+    assert st.wasted == st.drafted - st.accepted
+    # prefill emits one token, every verify launch one more; accepts are
+    # the rest (the tail launch may overshoot max_new and truncate)
+    assert 1 + st.launches + st.accepted >= st.emitted
+    d = st.to_dict()
+    assert d["acceptance_rate"] == pytest.approx(st.acceptance_rate, 1e-6)
+
+
+# ----------------------------------------------------- recompile discipline
+def test_one_verify_program_across_accept_patterns(small_gpt):
+    """The fixed-width contract: drafters of wildly different quality,
+    droughts, seeds and temperatures all ride ONE verify_step program (and
+    one prefill program per prompt length)."""
+    m = small_gpt
+    rng = np.random.default_rng(7)
+    prompt = rng.integers(0, 160, 8).astype(np.int64)
+    ref = _dense_ref(m, prompt, 10)
+    _spec(m, prompt, 10)                                     # ngram, greedy
+    _spec(m, prompt, 10, drafter=ReplayDrafter(8, ref[8:]))  # accepts all
+    _spec(m, prompt, 10, drafter=SelfSpeculativeDrafter(
+        m, window=4, dtype=None, decode_kernel="xla"))
+    for seed in (1, 2, 3):
+        _spec(m, prompt, 10, temperature=0.8, top_k=12, seed=seed)
+    # every run in this module uses spec_k=4: ONE verify program total,
+    # regardless of drafter quality, temperature, seed or accept pattern
+    verify = [k for k in m._generate_cache if k[0] == "verify_step"]
+    assert len(verify) == 1, f"verify_step forked programs: {verify}"
+    # and one prefill program per (slots, chunk-width) shape
+    pre = [k for k in m._generate_cache
+           if k[0] == "prefill_chunk" and k[1] == 1 and k[2] == 8]
+    assert len(pre) == 1, f"prefill forked programs: {pre}"
+
+
+# ------------------------------------------- distribution correctness (χ²)
+def _cut_probs(logits, temperature, top_k):
+    """The traced sampler's transform, replayed in numpy: temperature
+    scale, top-k mask, softmax."""
+    scaled = np.asarray(logits, np.float64) / temperature
+    kth = np.sort(scaled)[-top_k]
+    scaled = np.where(scaled < kth, -np.inf, scaled)
+    e = np.exp(scaled - scaled.max())
+    return e / e.sum()
+
+
+def _chi_square(counts, probs, n):
+    support = probs > 0
+    exp = probs[support] * n
+    obs = counts[support]
+    assert counts[~support].sum() == 0, "sampled outside the top-k support"
+    return float(((obs - exp) ** 2 / exp).sum())
+
+
+def test_verify_step_first_token_law_matches_target(tiny_vocab_gpt):
+    """Rejection sampling is distribution-exact: over many seeds, the first
+    token emitted after the verify launch (the accepted draft OR the
+    masked-residual resample) is distributed as the target model's own
+    cut-softmax law — the accept/reject split must be invisible in the
+    marginal. Draft chosen mid-probability so both paths fire."""
+    from paddle_tpu.inference.kv_cache import PagedKVCache
+
+    m = tiny_vocab_gpt
+    T, TOPK, N = 0.9, 6, 400
+    prompt = np.array([1, 2, 3, 4, 5, 6], np.int64)
+    plen = len(prompt)
+
+    kv = PagedKVCache(*m._decode_cache_spec(), block_size=8, num_blocks=8,
+                      dtype="float32")
+    kv.reserve("chi", plen + 2)
+    tbl = np.asarray(kv.block_table("chi", pad_to=kv.blocks_for(plen + 2)),
+                     np.int32)[None]
+    tok = m.prefill_chunk(prompt[None], np.zeros(1, np.int64),
+                          np.asarray([plen], np.int64), kv, tbl,
+                          decode_kernel="xla")
+    c0 = int(np.asarray(tok._value)[0])
+
+    # target law after [prompt, c0], via the model's raw forward
+    logits = np.asarray(m(paddle.to_tensor(
+        np.concatenate([prompt, [c0]])[None]))._value)[0, -1]
+    p0 = _cut_probs(logits, T, TOPK)
+    # a draft the law sometimes accepts and sometimes rejects
+    mid = int(np.argsort(p0)[-3])
+    assert 0.05 < p0[mid] < 0.95
+
+    chunk = np.asarray([[c0, mid]], np.int64)       # K=1 (minimum width)
+    counts = np.zeros(24, np.int64)
+    accepts = 0
+    for seed in range(N):
+        acc, nxt = m.verify_step(
+            chunk, np.asarray([plen], np.int64), np.asarray([1], np.int64),
+            np.asarray([True]), kv, tbl,
+            max_lens=np.asarray([plen + 2], np.int64), temperature=T,
+            top_k=TOPK, seed=seed, decode_kernel="xla")
+        a = int(np.asarray(acc._value)[0])
+        first = mid if a == 1 else int(np.asarray(nxt._value)[0])
+        counts[first] += 1
+        accepts += a
+    kv.release("chi")
+
+    assert 0 < accepts < N                  # both paths actually exercised
+    # df = support-1 = 5; 25 is far out in the tail (p < 1e-3) yet still
+    # catches a wrong law (e.g. un-renormalized residual) by a mile
+    assert _chi_square(counts, p0, N) < 25.0
+
+
+def test_dense_fused_sampler_first_token_law(tiny_vocab_gpt):
+    """The fused in-scan dense sampler (the host-sync fix) draws from the
+    same cut-softmax law: first sampled token of generate() chi-squares
+    against the raw-forward target distribution."""
+    m = tiny_vocab_gpt
+    T, TOPK, N = 0.9, 6, 400
+    prompt = np.array([7, 3, 7, 3, 1, 0], np.int64)
+    logits = np.asarray(m(paddle.to_tensor(prompt[None]))._value)[0, -1]
+    p0 = _cut_probs(logits, T, TOPK)
+    counts = np.zeros(24, np.int64)
+    for seed in range(N):
+        out = m.generate(paddle.to_tensor(prompt[None]), max_new_tokens=1,
+                         temperature=T, top_k=TOPK, seed=seed, dtype=None,
+                         decode_kernel="xla")
+        counts[int(np.asarray(out._value)[0, -1])] += 1
+    assert _chi_square(counts, p0, N) < 25.0
+
+
+def test_sampled_speculative_stays_in_vocab_and_terminates(small_gpt):
+    m = small_gpt
+    st = SpecStats()
+    out = _spec(m, np.array([11, 13, 17, 19], np.int64), 12,
+                temperature=1.1, top_k=20, seed=123, stats=st)
+    assert out.shape == (16,)
+    assert (out >= 0).all() and (out < 160).all()
+    assert st.emitted == 12
