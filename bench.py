@@ -612,6 +612,134 @@ def speculative_decode_fields(out):
     return out
 
 
+def bench_prefix_caching(on_accel, dev):
+    """Prefix caching on a multi-turn chat replay (ISSUE-11 acceptance):
+    the same 4-turn conversation served twice by the continuous scheduler —
+    once cold (prefix_cache off) and once warm (prefix_cache on). Each
+    turn's prompt is the previous turn's FULL output plus a fresh user
+    suffix, the canonical chat shape where every prompt is a strict
+    extension of indexed history. The warm leg should admit each follow-up
+    turn at ~O(new tokens): `prefill_savings_pct` counts prompt tokens the
+    index skipped, and the final turn's time-to-first-token (measured
+    through `infer_stream`, first flush) must collapse vs the cold leg.
+    Outputs must stay bit-identical — a prefix hit changes which KV rows
+    are recomputed, never what any program computes."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.scheduler import (
+        ContinuousGenerateBatchingPredictor,
+    )
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                    num_heads=4, max_position=128)
+    kern = "pallas" if on_accel else "xla"
+    P0, SUF, NEW, TURNS = 24, 8, 16, 4
+    bs, blocks, chunk, steps = 8, 64, 16, 4
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(0)
+    ids0 = rng.randint(0, cfg.vocab_size, P0).astype(np.int64)
+    suffixes = [rng.randint(0, cfg.vocab_size, SUF).astype(np.int64)
+                for _ in range(TURNS)]
+    warmup_ids = rng.randint(0, cfg.vocab_size, P0).astype(np.int64)
+    max_seq = P0 + TURNS * (NEW + SUF)   # final turn prompt + its output
+
+    def make(prefix_cache):
+        return ContinuousGenerateBatchingPredictor(
+            model, max_slots=2, prefill_chunk=chunk, decode_steps=steps,
+            max_new_tokens=NEW, decode_kernel=kern, block_size=bs,
+            num_blocks=blocks, max_seq_len=max_seq,
+            prefix_cache=prefix_cache)
+
+    def replay(sched, outs_ref=None):
+        """Serve the conversation turn by turn over infer_stream; prompts
+        grow from `outs_ref` (the cold outputs) so both legs see identical
+        traffic even if parity were broken."""
+        ttfts, outs, total_prompt = [], [], 0
+        prompt = ids0
+        for t in range(TURNS):
+            total_prompt += len(prompt)
+            t0 = time.perf_counter()
+            it = sched.infer_stream(prompt, timeout=600,
+                                    max_new_tokens=NEW)
+            first, chunks = None, []
+            for ch in it:
+                if first is None:
+                    first = time.perf_counter() - t0
+                chunks.append(np.asarray(ch, np.int64))
+            ttfts.append(first if first is not None
+                         else time.perf_counter() - t0)
+            gen = (np.concatenate(chunks) if chunks
+                   else np.zeros(0, np.int64))
+            outs.append(gen)
+            grow = outs_ref[t] if outs_ref is not None else gen
+            prompt = np.concatenate([prompt, grow, suffixes[t]])
+        return ttfts, outs, total_prompt
+
+    cold = make(prefix_cache=False)
+    try:
+        cold.infer(warmup_ids, timeout=600, max_new_tokens=NEW)  # compile
+        cold_ttfts, cold_outs, prompt_tokens = replay(cold)
+    finally:
+        cold.close()
+
+    warm = make(prefix_cache=True)
+    try:
+        warm.infer(warmup_ids, timeout=600, max_new_tokens=NEW)  # compile
+        h0 = warm.metrics.snapshot().get("prefix_hit_tokens", 0)
+        warm_ttfts, warm_outs, _ = replay(warm, outs_ref=cold_outs)
+        snap = warm.metrics.snapshot()
+    finally:
+        warm.close()
+
+    parity = ("ok" if all(np.array_equal(c, w)
+                          for c, w in zip(cold_outs, warm_outs))
+              else "mismatch")
+    out = dict(snap)
+    out.update(
+        turns=TURNS, prompt0=P0, suffix_tokens=SUF, new_tokens=NEW,
+        block_size=bs, pool_blocks=blocks, prefill_chunk=chunk,
+        prompt_tokens_total=prompt_tokens,
+        prefix_hit_tokens=int(snap.get("prefix_hit_tokens", 0) - h0),
+        cold_ttft_ms=[round(t * 1e3, 2) for t in cold_ttfts],
+        warm_ttft_ms=[round(t * 1e3, 2) for t in warm_ttfts],
+        cold_final_ttft_ms=round(cold_ttfts[-1] * 1e3, 2),
+        warm_final_ttft_ms=round(warm_ttfts[-1] * 1e3, 2),
+        parity=parity,
+    )
+    prefix_caching_fields(out)
+    return out, None
+
+
+def prefix_caching_fields(out):
+    """Savings + audit fields for the prefix_caching section: prompt tokens
+    skipped via the index -> `prefill_savings_pct` (gated >= 40 — the 4-turn
+    replay shares ~80% of its prompt tokens, so under half means the index
+    is not matching), final-turn TTFT cold/warm -> `ttft_ratio_cold_over_warm`
+    (gated >= 1.5 — the warm leg prefills one chunk instead of six), and the
+    bit-exactness `parity` field folded into the audit. Pure function of the
+    measured dict so tests can pin the wiring on synthetic inputs."""
+    tot = out.get("prompt_tokens_total")
+    hit = out.get("prefix_hit_tokens")
+    if tot and hit is not None:
+        out["prefill_savings_pct"] = round(100.0 * hit / tot, 1)
+    c, w = out.get("cold_final_ttft_ms"), out.get("warm_final_ttft_ms")
+    if c and w:
+        out["ttft_ratio_cold_over_warm"] = round(c / w, 2)
+    if ("parity" in out and "prefill_savings_pct" in out
+            and "ttft_ratio_cold_over_warm" in out):
+        if out["parity"] != "ok":
+            out["audit"] = "parity-mismatch"
+        elif out["prefill_savings_pct"] < 40.0:
+            out["audit"] = "low-savings"
+        elif out["ttft_ratio_cold_over_warm"] < 1.5:
+            out["audit"] = "ttft-flat"
+        else:
+            out["audit"] = "ok"
+    return out
+
+
 def bench_observability_overhead(on_accel, dev):
     """Instrumentation-cost leg (ISSUE-3): the serving-pressure workload run
     on ONE model with the observability layer enabled (request tracing +
@@ -1243,6 +1371,15 @@ def main():
     except Exception:
         pass
     try:
+        prefix, prefix_err = bench_prefix_caching(on_accel, dev)
+    except Exception as e:
+        prefix, prefix_err = None, {"error": repr(e)[:200]}
+    gc.collect()
+    try:
+        jax.clear_caches()
+    except Exception:
+        pass
+    try:
         obs, obs_err = bench_observability_overhead(on_accel, dev)
     except Exception as e:
         obs, obs_err = None, {"error": repr(e)[:200]}
@@ -1322,6 +1459,7 @@ def main():
             "continuous_serving": (continuous if continuous is not None
                                    else continuous_err),
             "speculative_decode": spec if spec is not None else spec_err,
+            "prefix_caching": prefix if prefix is not None else prefix_err,
             "observability_overhead": obs if obs is not None else obs_err,
             "train_observability_overhead": (train_obs if train_obs is not None
                                              else train_obs_err),

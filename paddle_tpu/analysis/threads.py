@@ -77,6 +77,7 @@ RUNTIME_MODULES = (
     "inference/serving.py",
     "inference/scheduler.py",
     "inference/kv_cache.py",
+    "inference/prefix_cache.py",
     "inference/resilience.py",
     "inference/faults.py",
     "framework/checkpoint.py",

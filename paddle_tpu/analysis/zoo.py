@@ -15,6 +15,12 @@ One place builds the programs the CLI ``--self-check``, the bench
   serving loop launches thousands of times per second, so host-sync and
   recompile-hazard findings here are deploy blockers; their fixed
   slot/table widths are what keeps them recompile-clean by construction.
+* ``gpt_prefill_prefix`` — the SAME chunked-prefill program, launched the
+  way a prefix-cache hit launches it (inference/prefix_cache.py): the live
+  slot resumes at a nonzero offset past the shared prefix blocks, writing
+  only into its private tail. Offsets are traced inputs, so a warm start
+  must not change the program shape — this entry is the recompile-hazard
+  gate for the hit path.
 * ``gpt_verify_step`` — the speculative-decoding verifier
   (models/generation.py ``verify_step``): scores a fixed-width ``[S, K+1]``
   draft chunk in one forward and runs rejection sampling in-program. Same
@@ -222,6 +228,70 @@ def gpt_decode_step_report(thresholds=None, allowlist=None):
         _thresholds=thresholds, _allowlist=allowlist)
 
 
+def gpt_prefill_prefix_report(thresholds=None, allowlist=None):
+    """Chunked prefill entered through a prefix-cache hit.
+
+    A donor request commits a 16-token prefix, registers it and releases
+    (parking two full blocks in the evictable tier); a second request with
+    the same prefix plus an 8-token novel suffix reserves THROUGH the
+    shared pairs and prefills only the suffix at offset 16. The analyzed
+    program is byte-for-byte the cold prefill program — same runner cache
+    key — which is the point: a hit changes only the (traced) offsets, so
+    it can never trigger a recompile or write into shared blocks."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.inference.kv_cache import PagedKVCache
+    from paddle_tpu.inference.prefix_cache import PrefixCache
+
+    from .core import analyze
+
+    cfg, model = _gpt_smoke()
+    model.eval()
+    S, BS, PFX, C, NEW = 2, 8, 16, 8, 4
+    # block_size=8 so a 16-token prefix is exactly two FULL shareable
+    # blocks; 1024 blocks keeps each pool at the 1 MiB donation threshold
+    # (4 kv heads x 16 head_dim x bf16) so the CPU donation allowlist path
+    # stays exercised, same as the other paged entries.
+    kv = PagedKVCache(cfg.num_layers, cfg.num_kv_heads,
+                      cfg.hidden_size // cfg.num_heads,
+                      block_size=BS, num_blocks=1024, dtype="bfloat16")
+    px = PrefixCache(kv)
+    rs = np.random.RandomState(0)
+    prefix = rs.randint(0, cfg.vocab_size, PFX).astype(np.int64)
+    suffix = rs.randint(0, cfg.vocab_size, C).astype(np.int64)
+    # donor: commit the prefix, index it, release -> two parked blocks
+    kv.reserve("donor", PFX)
+    kv.append_tokens("donor", PFX)
+    px.register("donor", prefix)
+    kv.release("donor")
+    # hit: reserve through the shared pairs; committed length lands at 16
+    hit = px.lookup(np.concatenate([prefix, suffix]))
+    kv.reserve("hit", PFX + C + NEW, shared=hit.pairs)
+    assert kv.length("hit") == PFX, "zoo prefix hit did not attach"
+    nb = kv.blocks_for(PFX + C + NEW)
+    tbl = np.zeros((S, nb), np.int32)
+    tbl[0] = kv.block_table("hit", pad_to=nb)
+    ids = np.zeros((S, C), np.int64)
+    ids[0] = suffix
+    offs = np.asarray([PFX, 0], np.int64)   # resume PAST the shared prefix
+    lens = np.asarray([C, 0], np.int64)     # slot 1 idle (masked)
+    model.prefill_chunk(ids, offs, lens, kv, tbl)   # builds + caches runner
+    run = model.compiled_prefill_chunk_runner(S, C)
+    return analyze(
+        run, model._decode_state(jnp.bfloat16), jnp.asarray(ids),
+        jnp.asarray(offs, jnp.int32), jnp.asarray(lens, jnp.int32),
+        jnp.asarray(tbl, jnp.int32),
+        jnp.zeros((S,), jnp.float32), jnp.zeros((S,), jnp.int32),
+        tuple(kv.k_pages), tuple(kv.v_pages),
+        jax.random.key(0),
+        _name="gpt.decode.paged_prefill_prefix",
+        _arg_labels=("state", "chunk", "offsets", "chunk_lens", "tables",
+                     "temperatures", "top_ks", "k_pages", "v_pages",
+                     "rng_key"),
+        _thresholds=thresholds, _allowlist=allowlist)
+
+
 def gpt_verify_step_report(thresholds=None, allowlist=None):
     import jax
 
@@ -260,6 +330,7 @@ ZOO_PROGRAMS = {
     "gpt_decode_dense": gpt_decode_dense_report,
     "gpt_decode_paged": gpt_decode_paged_report,
     "gpt_prefill_chunk": gpt_prefill_chunk_report,
+    "gpt_prefill_prefix": gpt_prefill_prefix_report,
     "gpt_decode_step": gpt_decode_step_report,
     "gpt_verify_step": gpt_verify_step_report,
 }

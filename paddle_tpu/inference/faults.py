@@ -15,6 +15,13 @@ site                   where it is checked
                        mutation — an injected ``CacheOutOfBlocks`` models a
                        genuinely dry pool)
 ``kv.allocate``        entry of ``BlockAllocator.allocate``
+``kv.prefix_match``    entry of ``PrefixCache.lookup`` (the scheduler treats
+                       ANY lookup failure as a cache miss — an injected error
+                       here proves admission degrades cold, never fails)
+``kv.prefix_evict``    entry of ``PrefixCache._reclaim`` — parked-tier
+                       eviction under pool pressure, inside ``reserve``'s
+                       atomic section (the chaos leg races this against
+                       concurrent admissions)
 ``batcher.tick``       top of the batcher thread loop (a ``ThreadDeath``
                        here kills the worker with the queue intact)
 ``batcher.batch``      start of ``_run_batch`` (a ``ThreadDeath`` here kills

@@ -116,6 +116,12 @@ class PagedKVCache:
         self._faults = faults
         self.evictions = 0          # finished-but-retained requests reclaimed
         self.evicted_blocks = 0     # blocks those evictions returned
+        # per-block holder counts: how many request tables reference each
+        # allocated block. Without a prefix cache every count is exactly 1
+        # and the pre-sharing semantics are unchanged; with one attached,
+        # reserve(shared=...) bumps counts and release only frees at zero.
+        self._block_refs: dict[int, int] = {}
+        self._prefix = None         # PrefixCache | None (attach_prefix_cache)
         # host bookkeeping is hit from HTTP handler threads (admission
         # checks), the batcher thread (reserve/release), and clients
         # (gather); RLock because reserve -> _evict_lru -> release re-enters
@@ -129,6 +135,15 @@ class PagedKVCache:
 
     def blocks_for(self, seq_len: int) -> int:
         return max(1, math.ceil(seq_len / self.block_size))
+
+    def attach_prefix_cache(self, prefix):
+        """Wire a PrefixCache into release/evict: refcount-zero indexed
+        blocks park in its LRU tier instead of freeing, and _evict_lru
+        drains that tier after finished-but-retained requests."""
+        with self._lock:
+            if self._prefix is not None and self._prefix is not prefix:
+                raise ValueError("a prefix cache is already attached")
+            self._prefix = prefix
 
     # ---------------------------------------------------------- observability
     def bind_metrics(self, registry, pool="kv"):
@@ -170,33 +185,61 @@ class PagedKVCache:
         return self
 
     # ----------------------------------------------------------- allocation
-    def reserve(self, request_id, max_seq_len: int, evict: bool = True):
+    def reserve(self, request_id, max_seq_len: int, evict: bool = True,
+                shared=None):
         """Allocate blocks covering max_seq_len for a new request; returns the
         block table as int32 [num_blocks_for(max_seq_len)]. When the free list
         runs dry and `evict`, finished-but-retained requests are evicted
-        least-recently-used first.
+        least-recently-used first, then the prefix cache's parked tier.
+
+        ``shared`` is an optional list of (digest, block) pairs from a
+        ``PrefixCache.lookup`` — the hint is revalidated HERE, under this
+        lock (truncated at the first stale link), so a parked block evicted
+        between lookup and reserve silently degrades the hit instead of
+        aliasing someone else's pages. Validated blocks take a refcount and
+        become the table's leading entries; the request's committed length
+        starts at ``n_shared * block_size`` (those rows are already in the
+        pool). Shared blocks never cover the final prompt token, so the
+        first write a request issues lands past every shared block.
 
         Atomic: either the request ends up fully reserved, or the cache is
         byte-identical to before the call — in particular, nothing is evicted
-        when eviction still could not cover the allocation (the old
-        evict-then-fail path destroyed retained caches for nothing)."""
+        when eviction still could not cover the allocation, and a failed
+        reservation re-parks any prefix blocks it had acquired."""
         with self._lock:
             if self._faults is not None:
                 self._faults.check("kv.reserve")  # injected pool-dry faults
             if request_id in self._requests:
                 raise ValueError(f"request {request_id!r} already reserved")
             n = self.blocks_for(max_seq_len)
-            if self.allocator.available < n:
-                shortfall = n - self.allocator.available
-                if not evict or self.evictable_blocks < shortfall:
-                    raise CacheOutOfBlocks(
-                        f"need {n} blocks, {self.allocator.available} free + "
-                        f"{self.evictable_blocks if evict else 0} evictable "
-                        f"of {self.num_blocks}")
-                self._evict_lru(shortfall)
-            blocks = self.allocator.allocate(n)  # raises CacheOutOfBlocks
-            self._requests[request_id] = _Request(blocks, 0,
-                                                  next(self._clock))
+            acquired: list[int] = []
+            if shared and self._prefix is not None:
+                # refcounts bump immediately so a done-holder released by the
+                # eviction below can neither free nor re-park these blocks
+                acquired = self._prefix._acquire(list(shared)[:n])
+                for b in acquired:
+                    self._block_refs[b] = self._block_refs.get(b, 0) + 1
+            try:
+                need_new = n - len(acquired)
+                if self.allocator.available < need_new:
+                    shortfall = need_new - self.allocator.available
+                    if not evict or self._evictable_locked() < shortfall:
+                        raise CacheOutOfBlocks(
+                            f"need {need_new} blocks, "
+                            f"{self.allocator.available} free + "
+                            f"{self._evictable_locked() if evict else 0} "
+                            f"evictable of {self.num_blocks}")
+                    self._evict_lru(shortfall)
+                fresh = self.allocator.allocate(need_new)  # CacheOutOfBlocks
+            except BaseException:
+                for b in acquired:     # undo: cache byte-identical to before
+                    self._unref(b)
+                raise
+            for b in fresh:
+                self._block_refs[b] = 1
+            blocks = acquired + fresh
+            self._requests[request_id] = _Request(
+                blocks, len(acquired) * self.block_size, next(self._clock))
             return np.asarray(blocks, np.int32)
 
     def _evict_lru(self, need: int):
@@ -207,10 +250,19 @@ class PagedKVCache:
             for rid, req in done:
                 if freed >= need:
                     break
-                freed += len(req.blocks)
+                # blocks shared with live requests (or parked by the index)
+                # don't come home on release — count the ACTUAL frees
+                avail0 = self.allocator.available
                 self.evictions += 1
-                self.evicted_blocks += len(req.blocks)
                 self.release(rid)
+                got = self.allocator.available - avail0
+                freed += got
+                self.evicted_blocks += got
+            if freed < need and self._prefix is not None:
+                got = self._prefix._reclaim(need - freed)
+                if got:
+                    self.allocator.free(got)
+                    self.evicted_blocks += len(got)
 
     def mark_done(self, request_id):
         """Request finished decoding; its pages stay readable (gather) but
@@ -221,7 +273,23 @@ class PagedKVCache:
     def release(self, request_id):
         with self._lock:
             req = self._requests.pop(request_id)
-            self.allocator.free(req.blocks)
+            for b in req.blocks:
+                self._unref(b)
+
+    def _unref(self, block: int):
+        """Drop one holder reference. At zero, an indexed block parks in
+        the prefix tier (still matchable, reclaimable on demand); anything
+        else goes back to the allocator. Callers already hold the lock —
+        re-entering the RLock here keeps the method safe standalone."""
+        with self._lock:
+            r = self._block_refs[block] - 1
+            if r > 0:
+                self._block_refs[block] = r
+                return
+            del self._block_refs[block]
+            if self._prefix is not None and self._prefix._park(block):
+                return
+            self.allocator.free([block])
 
     # ------------------------------------------------------------- metadata
     def block_table(self, request_id, pad_to=None):
@@ -277,10 +345,27 @@ class PagedKVCache:
 
     @property
     def evictable_blocks(self) -> int:
-        """Blocks held by finished-but-retained requests (reclaimable)."""
+        """Blocks reclaimable on demand: held ONLY by finished-but-retained
+        requests (a done request's block shared with a live one cannot come
+        home), plus the prefix cache's parked tier."""
         with self._lock:
-            return sum(len(r.blocks) for r in self._requests.values()
-                       if r.done)
+            return self._evictable_locked()
+
+    def _evictable_locked(self) -> int:
+        done_held: set[int] = set()
+        live_held: set[int] = set()
+        for r in self._requests.values():
+            (done_held if r.done else live_held).update(r.blocks)
+        n = len(done_held - live_held)
+        if self._prefix is not None:
+            n += self._prefix.cached_blocks()
+        return n
+
+    @property
+    def shared_block_count(self) -> int:
+        """Blocks referenced by two or more request tables (the CoW wins)."""
+        with self._lock:
+            return sum(1 for v in self._block_refs.values() if v > 1)
 
     @property
     def utilization(self) -> float:
@@ -297,35 +382,62 @@ class PagedKVCache:
 
     # ----------------------------------------------------------- invariants
     def check_conservation(self) -> dict:
-        """Ground-truth audit of the allocator + request bookkeeping; raises
-        AssertionError on any violation, returns the recomputed stats.
+        """Ground-truth audit of the allocator + request + refcount
+        bookkeeping; raises AssertionError on any violation, returns the
+        recomputed stats.
 
         Invariants (the ones the continuous scheduler's churn leans on):
-        * no block appears in two live requests' tables (no aliased pages);
-        * the union of request-held blocks == the allocator's live set;
-        * free + in-use partitions the pool exactly;
+        * no block appears TWICE in one request's table, and every shared
+          block's refcount equals a from-scratch recount of its holders
+          (without a prefix cache this degenerates to the old rule: every
+          block has exactly one owner);
+        * held ∪ parked == the allocator's live set, held ∩ parked == ∅ —
+          i.e. free ∪ live ∪ cached partitions the pool, with shared blocks
+          counted ONCE (set semantics);
+        * parked ⊆ indexed ⊆ live: the content index never names a freed
+          block, and every parked block is matchable;
         * every request's length fits its reserved capacity;
         * ``live_utilization`` matches a from-scratch recomputation.
         Cheap enough to call after every op in the property tests and at the
         end of chaos storms."""
         with self._lock:
-            owner: dict[int, object] = {}
+            holders: dict[int, int] = {}
             for rid, req in self._requests.items():
+                seen_here: set[int] = set()
                 for b in req.blocks:
                     assert 0 <= b < self.num_blocks, \
                         f"request {rid!r} holds out-of-pool block {b}"
-                    assert b not in owner, \
-                        (f"block {b} shared by {owner[b]!r} and {rid!r}")
-                    owner[b] = rid
+                    assert b not in seen_here, \
+                        f"block {b} appears twice in {rid!r}'s table"
+                    seen_here.add(b)
+                    holders[b] = holders.get(b, 0) + 1
                 cap = len(req.blocks) * self.block_size
                 assert req.length <= cap, \
                     (f"request {rid!r} length {req.length} exceeds "
                      f"capacity {cap}")
+            if holders != self._block_refs:
+                diff = {b: (holders.get(b), self._block_refs.get(b))
+                        for b in set(holders) | set(self._block_refs)
+                        if holders.get(b) != self._block_refs.get(b)}
+                raise AssertionError(
+                    f"refcounts diverge from recounted holders "
+                    f"(block: (recount, refs)) = {diff}")
+            held = set(holders)
+            if self._prefix is not None:
+                parked, indexed = self._prefix._tier_snapshot()
+            else:
+                parked, indexed = set(), set()
+            assert not (held & parked), \
+                f"blocks both held and parked: {held & parked}"
             live = self.allocator._live
-            assert set(owner) == live, \
-                (f"request-held blocks != allocator live set "
-                 f"(held-not-live={set(owner) - live}, "
-                 f"live-not-held={live - set(owner)})")
+            assert held | parked == live, \
+                (f"held ∪ parked != allocator live set "
+                 f"(held∪parked-not-live={(held | parked) - live}, "
+                 f"live-not-accounted={live - held - parked})")
+            assert parked <= indexed, \
+                f"parked blocks missing from index: {parked - indexed}"
+            assert indexed <= live, \
+                f"index names freed blocks: {indexed - live}"
             free = set(self.allocator._free)
             assert len(free) == len(self.allocator._free), \
                 "free list contains duplicates"
@@ -333,14 +445,15 @@ class PagedKVCache:
             assert len(free) + len(live) == self.num_blocks, \
                 (f"free ({len(free)}) + live ({len(live)}) != "
                  f"pool size {self.num_blocks}")
-            evictable = sum(len(r.blocks) for r in self._requests.values()
-                            if r.done)
+            evictable = self._evictable_locked()
             expect_live_util = (len(live) - evictable) / self.num_blocks
             n_requests = len(self._requests)
             got = self.live_utilization
         assert abs(got - expect_live_util) < 1e-9, \
             f"live_utilization {got} != ground truth {expect_live_util}"
         return {"live": len(live), "free": len(free), "evictable": evictable,
+                "cached": len(parked), "shared":
+                    sum(1 for v in holders.values() if v > 1),
                 "requests": n_requests, "live_utilization": got}
 
     # ------------------------------------------------------------ device I/O

@@ -1,0 +1,277 @@
+"""Content-addressed prefix cache over the paged KV pool (ISSUE-11 tentpole).
+
+RadixAttention (SGLang) / vLLM automatic-prefix-caching, rebuilt on our
+``PagedKVCache``: full KV blocks are indexed by a chain digest of their
+token content — ``digest_i = blake2b(digest_{i-1} || tokens[i*BS:(i+1)*BS])``
+— so a digest names not just a block's own tokens but the entire prefix
+behind it, and a chain match is a prefix match by construction.
+
+Sharing model (copy-on-write at block granularity):
+
+* **match** — at admission the scheduler hashes the prompt's full blocks
+  (capped at ``plen - 1`` tokens so the final prompt token ALWAYS
+  re-prefills: the cache stores KV rows, not logits, and that last
+  position's logits seed the first sample) and walks the index for the
+  longest indexed chain.
+* **share** — ``PagedKVCache.reserve(..., shared=hit.pairs)`` revalidates
+  the chain under the kv lock (a block evicted or re-registered since the
+  lookup truncates the chain at the first stale link), bumps per-block
+  refcounts, and hands the request a table whose leading entries are the
+  shared blocks. Shared blocks are structurally read-only: a hit covers at
+  most ``plen - 1`` tokens, and every prefill/decode/verify write lands at
+  rows ``>= plen`` (chunked prefill resumes at the first novel token, which
+  lives in the first PRIVATE block). The tail block of any request is
+  therefore always private — "copy"-on-write never actually copies.
+* **park** — when a block's refcount drops to zero on release, an indexed
+  block parks in an LRU tier instead of freeing: still resident, still
+  matchable, reclaimable on demand.
+* **reclaim** — under pool pressure ``_evict_lru`` drains the parked tier
+  LRU-first (after finished-but-retained requests), dropping index entries
+  as blocks return to the allocator. ``reserve`` stays atomic: the
+  shortfall precheck counts parked blocks as evictable, and a failed
+  reservation re-parks anything it had acquired.
+
+Locking: this index has its own lock, and the STRICT order is
+``PagedKVCache._lock -> PrefixCache._lock`` (``_acquire``/``_park``/
+``_reclaim`` are called by kv-cache internals with the kv lock held;
+``lookup`` takes only the prefix lock; ``register`` takes kv first).
+Both the static thread lint (RUNTIME_MODULES) and the chaos-armed lock
+witness gate this edge.
+
+Fault sites: ``kv.prefix_match`` (lookup — the scheduler degrades a failed
+lookup to a cache miss) and ``kv.prefix_evict`` (tier reclaim under
+pressure — races concurrent admissions in the chaos suite).
+"""
+from __future__ import annotations
+
+import hashlib
+import itertools
+
+import numpy as np
+
+from ..analysis.lockwitness import make_rlock
+
+__all__ = ["PrefixCache", "PrefixHit"]
+
+_DIGEST_BYTES = 16
+
+
+class _Entry:
+    __slots__ = ("block", "touch")
+
+    def __init__(self, block, touch):
+        self.block = block
+        self.touch = touch
+
+
+class PrefixHit:
+    """Result of a lookup: the prompt's full-block digest chain (for later
+    registration) plus the matched ``(digest, block)`` prefix of it. The
+    pairs are a HINT — reserve revalidates them under the kv lock."""
+
+    __slots__ = ("digests", "pairs")
+
+    def __init__(self, digests, pairs):
+        self.digests = digests
+        self.pairs = pairs
+
+
+class PrefixCache:
+    """Content-addressed index + LRU parked tier over one ``PagedKVCache``.
+
+    Construction attaches the index to the kv cache (``attach_prefix_cache``)
+    so release/evict route through ``_park``/``_reclaim``. One index per
+    pool; first writer wins on digest collisions between concurrent
+    registrations (the loser keeps its private block — correctness never
+    depends on dedup, only capacity reuse does)."""
+
+    def __init__(self, kv_cache, faults=None):
+        self.kv = kv_cache
+        self.block_size = int(kv_cache.block_size)
+        self._faults = faults if faults is not None else kv_cache._faults
+        # digest -> _Entry, plus the reverse map for park/reclaim paths that
+        # start from a block id; parked = indexed blocks with refcount 0
+        self._index: dict[bytes, _Entry] = {}
+        self._by_block: dict[int, bytes] = {}
+        self._parked: set[int] = set()
+        self._clock = itertools.count()
+        self.hits = 0                 # lookups that matched >= 1 block
+        self.misses = 0
+        self.evicted_blocks_total = 0  # parked blocks reclaimed under pressure
+        self._lock = make_rlock("prefix_cache.PrefixCache._lock")
+        kv_cache.attach_prefix_cache(self)
+
+    # -------------------------------------------------------------- hashing
+    def hash_blocks(self, tokens) -> list:
+        """Chain digests for every FULL block of ``tokens``. Digest ``i``
+        commits to all tokens in blocks ``0..i`` — equal digests mean equal
+        prefixes (up to blake2b collisions, which we accept at 128 bits)."""
+        toks = np.ascontiguousarray(np.asarray(tokens, np.int64))
+        bs = self.block_size
+        out = []
+        parent = b""
+        for i in range(len(toks) // bs):
+            h = hashlib.blake2b(parent, digest_size=_DIGEST_BYTES)
+            h.update(toks[i * bs:(i + 1) * bs].tobytes())
+            parent = h.digest()
+            out.append(parent)
+        return out
+
+    # --------------------------------------------------------------- lookup
+    def lookup(self, prompt) -> PrefixHit:
+        """Longest indexed chain over the prompt's full blocks, capped at
+        ``plen - 1`` tokens (see module docstring: the last prompt token
+        must re-prefill so its logits exist to sample from). Takes only the
+        prefix lock — never the kv lock — so admission lookups cannot
+        invert against kv-cache internals calling back into this index."""
+        if self._faults is not None:
+            self._faults.check("kv.prefix_match")
+        prompt = np.asarray(prompt).reshape(-1)
+        n_match = max(0, (len(prompt) - 1) // self.block_size)
+        digests = self.hash_blocks(prompt)
+        pairs = []
+        with self._lock:
+            now = next(self._clock)
+            for d in digests[:n_match]:
+                e = self._index.get(d)
+                if e is None:
+                    break
+                e.touch = now          # popular prefixes stay resident
+                pairs.append((d, e.block))
+            if pairs:
+                self.hits += 1
+            else:
+                self.misses += 1
+        return PrefixHit(digests, pairs)
+
+    # ------------------------------------------------------------- indexing
+    def register(self, request_id, tokens, digests=None, length=None) -> int:
+        """Index ``request_id``'s full, COMMITTED blocks under their content
+        digests; returns how many new entries landed. Only rows actually
+        written to the pool are indexable: the cap is the kv-side committed
+        length, or the caller's ``length`` when the scheduler tracks
+        committed rows host-side (decode/verify ticks advance ``s.length``
+        without touching kv bookkeeping). First writer wins per digest.
+
+        Lock order: kv (read the request's blocks/length) then prefix."""
+        kv = self.kv
+        with kv._lock:
+            req = kv._requests.get(request_id)
+            if req is None:
+                return 0
+            cap = len(req.blocks) * self.block_size
+            committed = int(req.length) if length is None else int(length)
+            committed = min(committed, cap)
+            blocks = list(req.blocks)
+            n_full = min(committed, len(tokens)) // self.block_size
+            if n_full <= 0:
+                return 0
+            if digests is None:
+                digests = self.hash_blocks(
+                    np.asarray(tokens)[: n_full * self.block_size])
+            if len(digests) < n_full:
+                raise ValueError(
+                    f"register: {len(digests)} digests for {n_full} blocks")
+            added = 0
+            with self._lock:
+                now = next(self._clock)
+                for i in range(n_full):
+                    d = digests[i]
+                    if d in self._index or blocks[i] in self._by_block:
+                        continue      # first writer won, or block re-indexed
+                    self._index[d] = _Entry(blocks[i], now)
+                    self._by_block[blocks[i]] = d
+                    added += 1
+            return added
+
+    # ---------------------------------------------- kv-cache internal hooks
+    # All three run with PagedKVCache._lock already held (kv -> prefix order).
+    def _acquire(self, pairs) -> list:
+        """Revalidate a lookup's chain at reserve time: stop at the first
+        pair whose digest no longer maps to that block (evicted and possibly
+        re-registered since the lookup). Acquired parked blocks leave the
+        LRU tier; the caller takes the refcount."""
+        out = []
+        with self._lock:
+            for d, b in pairs:
+                e = self._index.get(d)
+                if e is None or e.block != b:
+                    break
+                self._parked.discard(b)
+                out.append(b)
+        return out
+
+    def _park(self, block) -> bool:
+        """Refcount hit zero: keep the block resident when it's indexed
+        (True), else tell the kv cache to free it (False)."""
+        with self._lock:
+            if block not in self._by_block:
+                return False
+            self._parked.add(block)
+            return True
+
+    def _reclaim(self, need: int) -> list:
+        """Evict up to ``need`` parked blocks LRU-first, dropping their
+        index entries; returns the block ids for the kv cache to free."""
+        if self._faults is not None:
+            self._faults.check("kv.prefix_evict")
+        with self._lock:
+            order = sorted(
+                self._parked,
+                key=lambda b: self._index[self._by_block[b]].touch)
+            out = []
+            for b in order[:max(0, int(need))]:
+                self._parked.discard(b)
+                d = self._by_block.pop(b)
+                self._index.pop(d, None)
+                out.append(b)
+            self.evicted_blocks_total += len(out)
+            return out
+
+    # ----------------------------------------------------------------- ops
+    def purge(self) -> int:
+        """Drop every PARKED block back to the allocator (index entries for
+        blocks still held by live requests survive). Admin/test hook —
+        returns how many blocks went home."""
+        with self.kv._lock:
+            blocks = self._reclaim(self.kv.num_blocks)
+            if blocks:
+                self.kv.allocator.free(blocks)
+            return len(blocks)
+
+    # -------------------------------------------------------- observability
+    def cached_blocks(self) -> int:
+        with self._lock:
+            return len(self._parked)
+
+    def indexed_blocks(self) -> int:
+        with self._lock:
+            return len(self._by_block)
+
+    def _tier_snapshot(self):
+        """(parked set, indexed block set) — for check_conservation, which
+        already holds the kv lock (kv -> prefix order)."""
+        with self._lock:
+            return set(self._parked), set(self._by_block)
+
+    def bind_metrics(self, registry, component="continuous"):
+        """``paddle_prefix_cache_blocks{state=cached|shared|indexed}`` as
+        callback-read gauges plus the monotonic eviction counter. "cached"
+        is the parked (refcount-zero, evictable) tier; "shared" counts
+        blocks referenced by 2+ live tables; "indexed" is every block the
+        content index can match (cached + live indexed)."""
+        g = registry.gauge(
+            "paddle_prefix_cache_blocks",
+            "Prefix-cache blocks by state: cached (parked, refcount 0), "
+            "shared (refcount >= 2), indexed (matchable)",
+            labels=("component", "state"))
+        g.labels(component, "cached").set_function(self.cached_blocks)
+        g.labels(component, "indexed").set_function(self.indexed_blocks)
+        g.labels(component, "shared").set_function(
+            lambda: self.kv.shared_block_count)
+        registry.counter(
+            "paddle_prefix_cache_evicted_blocks_total",
+            "Parked prefix blocks reclaimed under pool pressure",
+            labels=("component",)).labels(component).set_function(
+                lambda: self.evicted_blocks_total)
+        return self

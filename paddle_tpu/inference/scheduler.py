@@ -73,7 +73,8 @@ class _SlotSeq:
 
     __slots__ = ("req", "rid", "ids", "out_dtype", "plen", "pos", "tok",
                  "length", "generated", "table", "phase", "max_new", "order",
-                 "temperature", "top_k", "spec")
+                 "temperature", "top_k", "spec", "prefix_hit", "digests",
+                 "flushed")
 
     def __init__(self, req, rid, ids, out_dtype, max_new, order):
         self.req = req
@@ -98,6 +99,12 @@ class _SlotSeq:
         # the same verify program with draft_len 0 (no recompile)
         self.spec = True if getattr(req, "spec", None) is None else bool(
             req.spec)
+        # prefix-cache state (ISSUE-11): tokens satisfied from shared blocks
+        # at admission, the prompt's full-block digest chain (for indexing
+        # at prefill commit), and the streamed-token high-water mark
+        self.prefix_hit = 0
+        self.digests = None
+        self.flushed = 0
 
 
 class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
@@ -135,6 +142,15 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
     drafter              'ngram' (default; prompt-lookup, host-free) |
                          'self' (shallow-window reuse of the target model) |
                          any inference.speculative.Drafter instance.
+    prefix_cache         content-addressed KV block sharing (ISSUE-11):
+                         True builds a `PrefixCache` over this scheduler's
+                         pool (pass an instance to share one across
+                         predictors on the SAME pool). Admission consults
+                         the index and a hit skips chunked prefill straight
+                         to the first novel token — prefill cost ~O(new
+                         tokens) on overlapping traffic, token-identical
+                         output (greedy, sampled, and speculative paths).
+                         Default False: the pool behaves exactly as before.
     admit_policy         'fifo' (default) | 'shortest_prompt_first': free
                          slots take the queued request with the shortest
                          prompt (ties to the most urgent deadline, then
@@ -147,11 +163,12 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
 
     _component = "continuous"
     supports_sampler_knobs = True   # serving.py gates per-request headers
+    supports_streaming = True       # tick-boundary flushes -> infer_stream
 
     def __init__(self, model, max_slots=8, prefill_chunk=16,
                  prefill_token_budget=None, decode_steps=4, max_seq_len=None,
                  eos_token_id=None, max_defers=32, spec_k=0, drafter="ngram",
-                 admit_policy="fifo", **kwargs):
+                 admit_policy="fifo", prefix_cache=False, **kwargs):
         self.max_slots = int(max_slots)
         self.prefill_chunk = int(prefill_chunk)
         self.prefill_token_budget = int(prefill_token_budget
@@ -184,6 +201,11 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
         # each tick; greedy output is seed-independent (argmax)
         self._seed = itertools.count(1)
         # slot state exists BEFORE super().__init__ starts the loop thread
+        # (prefix attrs too: the tick loop reads them; the real PrefixCache
+        # is published below, after super() builds the kv pool — a tick
+        # that races attachment just serves its admissions cold)
+        self.prefix_cache = None
+        self._prefix_hit_counter = None
         self._slots: list = [None] * self.max_slots
         # gauges scrape from other threads; witness-wrapped under chaos
         self._slot_lock = make_lock(
@@ -199,6 +221,17 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
                              f"pool ({pool_tokens} tokens)")
         self.table_width = self.kv_cache.blocks_for(self.max_seq_len)
         self._spec_counter = self._bind_scheduler_metrics()
+        if prefix_cache:
+            from .prefix_cache import PrefixCache
+            pc = (prefix_cache if isinstance(prefix_cache, PrefixCache)
+                  else PrefixCache(self.kv_cache, faults=self._faults))
+            pc.bind_metrics(self.metrics.registry, component=self._component)
+            self._prefix_hit_counter = self.metrics.registry.counter(
+                "paddle_prefix_hit_tokens_total",
+                "Prompt tokens served from shared prefix blocks instead of "
+                "prefill compute", labels=("component",)).labels(
+                    self._component)
+            self.prefix_cache = pc      # published last: counter is ready
 
     # ------------------------------------------------------------- telemetry
     def _bind_scheduler_metrics(self):
@@ -305,6 +338,93 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
             req.spec = bool(spec)
         return self._submit(req)
 
+    def infer_stream(self, ids, timeout=None, deadline=None, trace_id=None,
+                     max_new_tokens=None, temperature=None, top_k=None,
+                     spec=None):
+        """Streaming twin of infer() (ISSUE-11): tokens arrive as the tick
+        loop absorbs them instead of at retirement.
+
+        Admission-time failures (ServerBusy / circuit open / malformed
+        request) raise HERE, synchronously — an HTTP front end still maps
+        them to proper 4xx/5xx statuses because no response bytes have
+        flushed yet. The return value is an iterator yielding int64 arrays
+        of newly generated tokens per tick-boundary flush; their
+        concatenation is exactly infer()'s generated suffix (same sampler,
+        same programs — streaming changes WHEN tokens are delivered, never
+        WHICH). Terminal failures after acceptance (deadline mid-stream,
+        shed, batch error) raise from the iterator; deadline semantics are
+        identical to _await's client-side cancel."""
+        req = self._make_request([np.asarray(ids)], timeout, deadline,
+                                 trace_id)
+        if max_new_tokens is not None:
+            req.max_new = max(1, min(int(max_new_tokens),
+                                     self.max_new_tokens))
+        if temperature is not None:
+            req.temperature = float(temperature)
+        if top_k is not None:
+            req.top_k = int(top_k)
+        if spec is not None:
+            req.spec = bool(spec)
+        q: queue.Queue = queue.Queue()
+        req.on_tokens = q.put       # published before enqueue (no races)
+        self._start(req)            # raises Rejected/ValueError/503 here
+        return self._stream_pump(req, q)
+
+    def _stream_pump(self, req, q):
+        """Generator half of infer_stream: drain the flush queue, mirroring
+        _await's deadline-cancel / supervisor-heal loop between flushes."""
+        try:
+            while True:
+                if req.deadline is None:
+                    step = 0.1
+                else:
+                    rem = req.deadline.remaining()
+                    if rem <= 0:
+                        if req.cancel():
+                            self.metrics.inc("timeouts")
+                            self._observe(req)
+                            if req.trace is not None:
+                                req.trace.finish("timeout", cas="timeout",
+                                                 where="client_stream")
+                            raise DeadlineExceeded(
+                                "inference request timed out mid-stream")
+                        break   # lost the race: terminal outcome landed
+                    step = min(0.1, rem)
+                try:
+                    yield np.asarray(q.get(timeout=step), np.int64)
+                    continue
+                except queue.Empty:
+                    pass
+                if req.event.is_set():
+                    break
+                try:
+                    if self._sup.heal():
+                        self.metrics.inc("batcher_restarts")
+                except ServiceUnavailable as e:
+                    self._fail(req, e)
+                    raise
+            # flushes that landed between the last drain and the terminal CAS
+            while True:
+                try:
+                    yield np.asarray(q.get_nowait(), np.int64)
+                except queue.Empty:
+                    break
+            if req.error is not None:
+                raise req.error
+        except GeneratorExit:
+            # consumer walked away mid-stream (client disconnect): same
+            # terminal path as a client-side timeout — the tick loop
+            # reclaims the slot at the next boundary
+            if req.cancel():
+                self.metrics.inc("timeouts")
+                self._observe(req)
+                if req.trace is not None:
+                    req.trace.finish("timeout", cas="timeout",
+                                     where="stream_abandoned")
+            raise
+        finally:
+            req.on_tokens = None
+
     def _admission_check(self, arrays):
         plen = len(arrays[0])
         total = plen + self.max_new_tokens
@@ -389,23 +509,65 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
             rid = ("cseq", seq_n)
             tr = req.trace
             traced = self.tracer.enabled
+            ids64 = np.asarray(arr, np.int64)
+            hit, t_px = None, 0.0
+            pc = self.prefix_cache
+            if pc is not None:
+                t_px = self.tracer.now_us() if traced else 0.0
+                try:
+                    hit = pc.lookup(ids64)   # fault site kv.prefix_match
+                except ThreadDeath:
+                    raise
+                except Exception as e:
+                    # a broken index lookup is a cache MISS, never a failed
+                    # request — the cold path below is always correct
+                    if traced and tr is not None:
+                        tr.child("prefix_lookup", t_px, self.tracer.now_us(),
+                                 error=repr(e))
+                    hit = None
             t_kv = self.tracer.now_us() if traced else 0.0
             try:
-                self.kv_cache.reserve(rid, plen + max_new)
+                self.kv_cache.reserve(
+                    rid, plen + max_new,
+                    shared=hit.pairs if hit is not None else None)
             except CacheOutOfBlocks as e:
                 if traced and tr is not None:
                     tr.child("kv_reserve", t_kv, self.tracer.now_us(),
                              error=repr(e))
                 self._shed_or_defer(req, e)
                 return
+            except Exception as e:
+                # an eviction-path fault (kv.prefix_evict chaos) is THIS
+                # request's admission failure, never a dead worker:
+                # reserve's undo left the pool byte-identical, so fail the
+                # one request and keep admitting (exactly-once terminal)
+                if traced and tr is not None:
+                    tr.child("kv_reserve", t_kv, self.tracer.now_us(),
+                             error=repr(e))
+                self._fail(req, e)
+                continue
             if traced and tr is not None:
                 tr.child("kv_reserve", t_kv, self.tracer.now_us(),
                          blocks=self.kv_cache.blocks_for(plen + max_new))
             self._end_queue_wait([req])
-            seq = _SlotSeq(req, rid, np.asarray(arr, np.int64), arr.dtype,
-                           max_new, seq_n)
+            seq = _SlotSeq(req, rid, ids64, arr.dtype, max_new, seq_n)
             seq.table = self.kv_cache.block_table(rid,
                                                   pad_to=self.table_width)
+            if hit is not None:
+                # rows already resident after revalidation: reserve set the
+                # committed length to the acquired shared blocks — chunked
+                # prefill resumes at the first novel token (~O(new tokens))
+                got = int(self.kv_cache.length(rid))
+                seq.prefix_hit = got
+                seq.pos = seq.length = got
+                seq.digests = hit.digests
+                if got:
+                    self.metrics.inc("prefix_hit_tokens", got)
+                    self._prefix_hit_counter.inc(got)
+                if traced and tr is not None:
+                    tr.child("prefix_lookup", t_px, self.tracer.now_us(),
+                             matched_blocks=len(hit.pairs),
+                             hit_tokens=got)
             with self._slot_lock:
                 self._slots[idx] = seq
             self.metrics.inc("admitted_seqs")
@@ -458,12 +620,18 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
             pass
 
     def _retire_ok(self, i, s):
+        out = np.concatenate(
+            [s.ids, np.asarray(s.generated[:s.max_new], np.int64)])
+        # index the generated tail BEFORE the audit-only set_length below
+        # rewrites the committed length: only rows actually written are
+        # indexable (a decode tick's final launch may sample past max_new,
+        # but the in-program write ceiling drops those rows — cap to it)
+        self._register_prefix(s, out, min(s.length, s.plen + s.max_new),
+                              digests=None)
         try:
             self.kv_cache.set_length(s.rid, s.plen + s.max_new)
         except (KeyError, ValueError):  # pragma: no cover - audit-only state
             pass
-        out = np.concatenate(
-            [s.ids, np.asarray(s.generated[:s.max_new], np.int64)])
         self._finish_req(s.req, out.astype(s.out_dtype))
         self._evict_slot(i, s)
         self.metrics.inc("retired_seqs")
@@ -505,10 +673,29 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
             if eos is not None and t == eos:
                 s.generated.extend([eos] * (s.max_new - len(s.generated)))
                 break
+        self._flush_stream(s)
         if len(s.generated) >= s.max_new:
             self._retire_ok(i, s)
             return True
         return False
+
+    def _flush_stream(self, s):
+        """Tick-boundary streaming (ISSUE-11): push newly absorbed tokens
+        through the request's on_tokens channel so infer_stream() clients
+        see them NOW, not at retirement. A broken consumer never takes the
+        tick loop down — the buffered result is still delivered."""
+        cb = s.req.on_tokens
+        if cb is None:
+            return
+        upto = min(len(s.generated), s.max_new)
+        if upto <= s.flushed:
+            return
+        chunk = s.generated[s.flushed:upto]
+        s.flushed = upto
+        try:
+            cb(list(chunk))
+        except Exception:       # pragma: no cover - consumer bug
+            pass
 
     def _fail_picks(self, picks, error, span_name, t0):
         self.breaker.record_failure()
@@ -585,10 +772,29 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
                 self.kv_cache.append_tokens(s.rid, take)
             except KeyError:    # pragma: no cover - raced an eviction
                 pass
+            self._register_prefix(s, s.ids, s.pos)
             if s.pos >= s.plen:
                 s.phase = _DECODE
                 s.tok = int(tk[i])
                 self._absorb(i, s, [s.tok])
+
+    def _register_prefix(self, s, tokens, committed, digests="prompt"):
+        """Index this sequence's freshly COMMITTED full blocks (prefill
+        chunks as they land, reusing the admission-time digest chain; the
+        whole prompt+generation at retirement, rehashed since generated
+        blocks have no precomputed digests). Registration is best-effort:
+        an index failure must never take the sequence with it."""
+        pc = self.prefix_cache
+        if pc is None:
+            return
+        try:
+            pc.register(s.rid, tokens,
+                        digests=s.digests if digests == "prompt" else None,
+                        length=int(committed))
+        except ThreadDeath:
+            raise
+        except Exception:       # pragma: no cover - index bug, stay cold
+            pass
 
     # --------------------------------------------------------------- decode
     def _decode_tick(self):
@@ -667,6 +873,14 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
         tks = np.zeros(S, np.int32)
         tables = np.zeros((S, self.table_width), np.int32)
         for i, s in dec:
+            # shared-prefix safety (ISSUE-11): a verify launch writes its
+            # whole window at [length, length+1+K) and rejection "rollback"
+            # is length bookkeeping only — length never drops below plen,
+            # and a prefix hit covers at most plen-1 tokens, so a verify
+            # tick can never write into (or roll back into) a shared block
+            assert s.length >= s.plen > s.prefix_hit, \
+                (f"verify tick would touch shared prefix rows: "
+                 f"length={s.length} plen={s.plen} hit={s.prefix_hit}")
             chunk[i, 0] = s.tok
             offs[i] = s.length
             maxlens[i] = s.plen + s.max_new

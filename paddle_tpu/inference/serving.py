@@ -74,7 +74,7 @@ class _Request:
 
     __slots__ = ("arrays", "event", "result", "error", "deadline", "retries",
                  "defers", "t0", "trace", "enq_us", "max_new", "temperature",
-                 "top_k", "spec", "_lock", "_state")
+                 "top_k", "spec", "on_tokens", "_lock", "_state")
 
     def __init__(self, arrays, deadline=None, trace=None):
         self.arrays = arrays
@@ -91,6 +91,10 @@ class _Request:
         self.temperature = None  # per-request sampling (continuous sched.)
         self.top_k = None
         self.spec = None        # tri-state speculative opt-out (continuous)
+        # streaming delivery channel (ISSUE-11): set by infer_stream before
+        # enqueue, called by the scheduler's tick loop with each newly
+        # absorbed token chunk; None = buffered (non-streaming) request
+        self.on_tokens = None
         self._lock = make_lock("serving._Request._lock")
         self._state = _PENDING
 
@@ -144,6 +148,12 @@ class BatchingPredictor:
     # per-slot sampler inputs; the whole-batch predictors run one sampler
     # config per compiled program, so the HTTP layer 400s the headers there
     supports_sampler_knobs = False
+
+    # SSE token streaming (ISSUE-11) needs tick-boundary flushes, which only
+    # the continuous scheduler produces; the HTTP layer 400s Accept:
+    # text/event-stream against whole-batch predictors instead of buffering
+    # silently (a "stream" that arrives all at once is a lie)
+    supports_streaming = False
 
     _component = "batcher"      # prometheus `component` label value
 
@@ -218,6 +228,14 @@ class BatchingPredictor:
         self._queue.put(req)
 
     def _submit(self, req):
+        self._start(req)
+        return self._await(req)
+
+    def _start(self, req):
+        """Synchronous admission half of _submit: shed/breaker/validation
+        outcomes raise HERE — so the streaming path (infer_stream) can
+        surface 4xx/5xx statuses before any response bytes flush — then
+        the accepted request enters the queue."""
         tr = req.trace
         t_adm = tr.now_us()
         try:
@@ -246,7 +264,6 @@ class BatchingPredictor:
         self.metrics.inc("accepted")
         req.t0 = self._clock()
         self._enqueue(req)
-        return self._await(req)
 
     def _await(self, req):
         """Wait for the terminal outcome, healing a dead batcher meanwhile."""
@@ -896,6 +913,90 @@ class InferenceServer:
                 else:
                     self._reply(404, b"")
 
+            def _wants_stream(self):
+                """SSE opt-in: `X-Stream: sse`, or Accept: text/event-stream
+                with no X-Stream override. A malformed X-Stream is a client
+                bug -> 400 (same contract as the sampler headers)."""
+                xs = self.headers.get("X-Stream")
+                if xs is not None:
+                    sv = xs.strip().lower()
+                    if sv not in ("sse", "off"):
+                        raise ValueError(
+                            f"malformed X-Stream {xs!r} (sse|off)")
+                    return sv == "sse"
+                return "text/event-stream" in (
+                    self.headers.get("Accept") or "")
+
+            def _generate_sse(self, ids):
+                """Chunked/SSE streaming for /generate (ISSUE-11): tokens
+                flush at tick boundaries, EVERY event carries the trace id
+                (SSE `id:` field AND the JSON payload), and deadline
+                semantics are unchanged — a mid-stream expiry arrives as an
+                `error` event naming status 504. Admission errors raise
+                before any bytes flush (infer_stream is eagerly admitted),
+                so 429/503/400 still travel as real HTTP statuses. The
+                response is close-delimited (HTTP/1.0): no Content-Length,
+                the `done`/`error` event is the terminator."""
+                import json
+
+                gen = outer.generator
+                if not getattr(gen, "supports_streaming", False):
+                    raise ValueError(
+                        "streaming needs the continuous scheduler "
+                        "(ContinuousGenerateBatchingPredictor); this "
+                        "server's generator buffers whole responses")
+                it = gen.infer_stream(ids, timeout=self._timeout(),
+                                      trace_id=self._trace_id(),
+                                      **self._sampler_knobs())
+                tid = self._trace_id()
+                # counted before any bytes flush, same contract as _reply
+                outer._http_responses.labels(self._metric_path(),
+                                             "200").inc()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("X-Trace-Id", tid)
+                self.end_headers()
+
+                def emit(event, payload):
+                    payload["trace_id"] = tid
+                    self.wfile.write(
+                        (f"id: {tid}\nevent: {event}\n"
+                         f"data: {json.dumps(payload)}\n\n").encode())
+                    self.wfile.flush()
+
+                sent = 0
+                try:
+                    for chunk in it:
+                        toks = [int(t) for t in
+                                np.asarray(chunk).reshape(-1)]
+                        sent += len(toks)
+                        emit("tokens", {"tokens": toks})
+                    emit("done", {"generated": sent,
+                                  "prompt_len": int(len(ids))})
+                except Exception as e:
+                    # headers are gone — the failure travels in-band, with
+                    # the same status taxonomy _fail_http would have used
+                    if isinstance(e, Rejected):
+                        status = e.status
+                    elif isinstance(e, TimeoutError):
+                        status = 504
+                    elif isinstance(e, CacheOutOfBlocks):
+                        status = 503
+                    elif isinstance(e, ValueError):
+                        status = 400
+                    else:
+                        status = 500
+                    try:
+                        emit("error", {"status": status, "error": repr(e)})
+                    except OSError:     # client went away mid-stream
+                        pass
+                finally:
+                    # a consumer-side failure (broken pipe) must cancel the
+                    # in-flight sequence NOW, not at GC time — close() fires
+                    # the pump's GeneratorExit cancel path deterministically
+                    it.close()
+
             def do_POST(self):
                 if outer._draining.is_set():
                     self._reply(503, b"draining", [("Retry-After", "1")])
@@ -905,6 +1006,9 @@ class InferenceServer:
                         n = int(self.headers.get("Content-Length", 0))
                         data = np.load(io.BytesIO(self.rfile.read(n)))
                         ids = data[data.files[0]]
+                        if self._wants_stream():
+                            self._generate_sse(ids)
+                            return
                         out = outer.generator.infer(ids,
                                                     timeout=self._timeout(),
                                                     trace_id=self._trace_id(),

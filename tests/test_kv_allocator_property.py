@@ -84,6 +84,93 @@ def test_randomized_churn_conserves_pool(seed):
     assert stats["reserve"] > 20, f"degenerate run: {stats}"
 
 
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_randomized_churn_with_prefix_sharing_conserves_pool(seed):
+    """ISSUE-11 extension of the churn property: the same seeded op soup
+    plus share/CoW/unreference traffic through a PrefixCache — register
+    indexes a live request's committed blocks, reserve_shared admits a new
+    request THROUGH a looked-up prefix (CoW sharing, refcount > 1), release
+    unreferences shared blocks back to the parked tier, and purge drops the
+    tier wholesale. A 3-symbol alphabet makes prefix collisions common, so
+    shared refcounts genuinely exercise the recount invariant after every
+    single op."""
+    from paddle_tpu.inference.prefix_cache import PrefixCache
+
+    rng = np.random.default_rng(seed)
+    kv = _mk_cache()
+    px = PrefixCache(kv)
+    live: dict = {}       # rid -> full token stream (len == reserved want)
+    done: set = set()
+    corpus: list = []     # token streams ever registered (lookup seeds)
+    next_rid = 0
+    stats = {"reserve": 0, "shared": 0, "hit_blocks": 0, "oom": 0,
+             "register": 0, "purge": 0}
+    for _ in range(400):
+        op = rng.choice(["reserve", "reserve_shared", "reserve_shared",
+                         "append", "register", "register", "mark_done",
+                         "release", "release", "purge"])
+        if op in ("reserve", "reserve_shared"):
+            want = int(rng.integers(1, 40))
+            rid = f"r{next_rid}"
+            if op == "reserve_shared" and corpus:
+                base = corpus[int(rng.integers(0, len(corpus)))]
+                toks = np.concatenate(
+                    [base, rng.integers(0, 3, want)])[:want].astype(np.int64)
+            else:
+                toks = rng.integers(0, 3, want).astype(np.int64)
+            hit = px.lookup(toks)
+            try:
+                kv.reserve(rid, want, shared=hit.pairs)
+                live[rid] = toks
+                next_rid += 1
+                stats["reserve"] += 1
+                stats["shared"] += bool(kv.length(rid))
+                stats["hit_blocks"] += kv.length(rid) // kv.block_size
+                for gone in set(live) - set(kv._requests):
+                    del live[gone]
+                    done.discard(gone)
+            except CacheOutOfBlocks:
+                stats["oom"] += 1
+        elif op == "append" and live:
+            rid = str(rng.choice(sorted(live)))
+            room = (kv.blocks_for(len(live[rid])) * kv.block_size
+                    - kv.length(rid))
+            if room > 0:
+                kv.append_tokens(rid, int(rng.integers(0, room + 1)))
+        elif op == "register" and live:
+            rid = str(rng.choice(sorted(live)))
+            # registering claims block CONTENT == these tokens; cap the
+            # stream at the committed length like the scheduler does
+            n = min(kv.length(rid), len(live[rid]))
+            px.register(rid, live[rid][:n], length=n)
+            corpus.append(live[rid][:n])
+            stats["register"] += 1
+        elif op == "mark_done" and live:
+            rid = str(rng.choice(sorted(live)))
+            if rid not in done:
+                kv.mark_done(rid)
+                done.add(rid)
+        elif op == "release" and live:
+            rid = str(rng.choice(sorted(live)))
+            if rid in kv._requests:
+                kv.release(rid)
+            del live[rid]
+            done.discard(rid)
+        elif op == "purge" and rng.integers(0, 8) == 0:   # rare, brutal
+            px.purge()
+            stats["purge"] += 1
+        kv.check_conservation()      # the property: holds after EVERY op
+    # drain everything; after a purge the pool must come back whole
+    for rid in list(live):
+        if rid in kv._requests:
+            kv.release(rid)
+    px.purge()
+    info = kv.check_conservation()
+    assert info["live"] == 0 and info["cached"] == 0
+    assert info["free"] == kv.num_blocks
+    assert stats["shared"] > 5, f"degenerate run (no sharing): {stats}"
+
+
 def test_reserve_is_atomic_under_eviction_shortfall():
     """The old evict-then-fail bug class: when eviction STILL cannot cover
     the allocation, nothing may have been evicted."""

@@ -1,0 +1,533 @@
+"""Prefix cache subsystem (ISSUE-11 tentpole): content-addressed CoW KV
+block sharing plus SSE token streaming.
+
+Three layers under test:
+
+* host-level — the ``PrefixCache`` index + ``PagedKVCache`` refcounts alone
+  (hash-chain matching, park/evict tiers, reserve atomicity, conservation);
+* model-level — the acceptance bar: a prefix-hit generation is BIT-IDENTICAL
+  to a cold one (greedy, sampled AND speculative), with admission skipping
+  straight past the shared blocks;
+* wire-level — ``infer_stream`` and the /generate SSE surface deliver the
+  same token sequence as the buffered path, trace id on every event.
+
+Chaos legs ride the lock witness (``@pytest.mark.chaos``): eviction racing
+admission must shed cleanly — exactly-once terminals, pool conserved.
+"""
+import io
+import itertools
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.kv_cache import CacheOutOfBlocks, PagedKVCache
+from paddle_tpu.inference.prefix_cache import PrefixCache
+from paddle_tpu.inference.scheduler import ContinuousGenerateBatchingPredictor
+
+
+@pytest.fixture(scope="module")
+def small_gpt():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    with paddle.utils.unique_name.guard():
+        paddle.seed(11)
+        m = GPTForCausalLM(GPTConfig(vocab_size=160, hidden_size=64,
+                                     num_layers=2, num_heads=4,
+                                     num_kv_heads=2, max_position=96,
+                                     dropout=0.0))
+    m.eval()
+    return m
+
+
+def _dense_ref(m, prompt, max_new, eos=None):
+    return np.asarray(m.generate(
+        paddle.to_tensor(np.asarray(prompt)[None]), max_new_tokens=max_new,
+        dtype=None, decode_kernel="xla", eos_token_id=eos)._value)[0]
+
+
+def _make(m, **kw):
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("prefill_chunk", 4)
+    kw.setdefault("decode_steps", 2)
+    kw.setdefault("max_new_tokens", 6)
+    kw.setdefault("decode_kernel", "xla")
+    kw.setdefault("block_size", 8)
+    kw.setdefault("num_blocks", 32)
+    kw.setdefault("max_seq_len", 40)
+    kw.setdefault("prefix_cache", True)
+    return ContinuousGenerateBatchingPredictor(m, **kw)
+
+
+# ---------------------------------------------------------------- host level
+def _cache(num_blocks=16, block_size=4):
+    kv = PagedKVCache(1, 2, 8, block_size=block_size,
+                      num_blocks=num_blocks, dtype="float32")
+    return kv, PrefixCache(kv)
+
+
+def _commit(kv, px, rid, tokens):
+    """Reserve + commit + index `tokens` for `rid` (host-side stand-in for
+    prefill; the index hashes token CONTENT, pool rows are irrelevant)."""
+    kv.reserve(rid, len(tokens))
+    kv.append_tokens(rid, len(tokens))
+    px.register(rid, np.asarray(tokens, np.int64))
+
+
+def test_lookup_matches_full_blocks_only_and_caps_tail():
+    """The tail block is never shared: a hit covers at most
+    (plen-1)//block_size FULL blocks, so >=1 prompt token always re-prefills
+    (the cache stores KV rows, not logits — the last position must run to
+    seed sampling)."""
+    kv, px = _cache()
+    toks = np.arange(10, dtype=np.int64)          # 2 full blocks + tail of 2
+    _commit(kv, px, "a", toks)
+    kv.release("a")
+    assert px.cached_blocks() == 2                # tail block freed, not parked
+
+    hit = px.lookup(toks)
+    assert len(hit.pairs) == 2                    # 8 of 10 tokens
+    # exact-multiple prompt: one block held back for the mandatory re-prefill
+    toks8 = np.arange(8, dtype=np.int64)
+    kv2, px2 = _cache()
+    _commit(kv2, px2, "a", toks8)
+    kv2.release("a")
+    assert len(px2.lookup(toks8).pairs) == 1
+    # divergent content misses past the shared prefix
+    fork = toks.copy()
+    fork[5] = 99                                  # inside block 1
+    assert len(px.lookup(fork).pairs) == 1        # block 0 still matches
+    assert len(px.lookup(fork + 100).pairs) == 0
+
+
+def test_shared_reserve_refcounts_and_conservation():
+    """Two live requests over one prefix: shared blocks counted ONCE in the
+    pool partition, refcounts recount exactly, and the blocks only park when
+    the LAST holder releases."""
+    kv, px = _cache()
+    toks = np.arange(12, dtype=np.int64)          # 3 full blocks
+    _commit(kv, px, "donor", toks)
+    kv.release("donor")
+    stats = kv.check_conservation()
+    assert stats["cached"] == 3 and kv.blocks_in_use == 3  # parked, not freed
+
+    h1 = px.lookup(np.concatenate([toks, [1, 2, 3]]))
+    kv.reserve("r1", 15, shared=h1.pairs)         # 3 shared + 1 private
+    assert kv.length("r1") == 12                  # admission skips 3 blocks
+    h2 = px.lookup(np.concatenate([toks, [7, 8, 9]]))
+    kv.reserve("r2", 15, shared=h2.pairs)
+    stats = kv.check_conservation()
+    assert stats["shared"] == 3 and stats["cached"] == 0
+    assert kv.shared_block_count == 3
+    assert kv.blocks_in_use == 5                  # shared counted ONCE
+
+    kv.release("r1")
+    stats = kv.check_conservation()
+    assert stats["shared"] == 0 and stats["cached"] == 0   # r2 still holds
+    assert kv.blocks_in_use == 4
+    kv.release("r2")
+    stats = kv.check_conservation()
+    assert stats["cached"] == 3 and kv.blocks_in_use == 3  # parked again
+
+
+def test_eviction_reclaims_lru_parked_blocks_under_pressure():
+    """Pool pressure reclaims the least-recently-touched parked entries
+    first; a fresh lookup refreshes recency and survives the next squeeze."""
+    kv, px = _cache(num_blocks=8, block_size=4)
+    old = np.arange(8, dtype=np.int64)
+    new = np.arange(100, 108, dtype=np.int64)
+    _commit(kv, px, "old", old)
+    kv.release("old")
+    _commit(kv, px, "new", new)
+    kv.release("new")
+    assert px.cached_blocks() == 4                # 2 + 2, pool is 8
+    px.lookup(old)                                # touch: "old" is now MRU
+    kv.reserve("big", 24)                         # needs 6 -> reclaim 2
+    stats = kv.check_conservation()
+    assert stats["cached"] == 2
+    assert px.evicted_blocks_total == 2
+    assert len(px.lookup(old).pairs) == 1         # MRU survived ((8-1)//4)
+    assert len(px.lookup(new).pairs) == 0         # LRU evicted
+    kv.release("big")
+    kv.check_conservation()
+
+
+def test_reserve_failure_leaves_cache_byte_identical():
+    """CacheOutOfBlocks isolation with sharing in play: a reservation that
+    cannot be satisfied even after eviction must leave refcounts, the parked
+    tier and the index exactly as found — acquired shared blocks are
+    re-parked, nothing leaks."""
+    kv, px = _cache(num_blocks=8, block_size=4)
+    toks = np.arange(8, dtype=np.int64)
+    _commit(kv, px, "donor", toks)
+    kv.release("donor")
+    kv.reserve("pin", 16)                         # 4 live + 2 parked + 2 free
+    before = kv.check_conservation()
+    hit = px.lookup(np.concatenate([toks, [1]]))
+    assert len(hit.pairs) == 2
+    with pytest.raises(CacheOutOfBlocks):
+        # 9 blocks total needed, 2 shared + 7 new > 4 available
+        kv.reserve("huge", 36, shared=hit.pairs)
+    after = kv.check_conservation()
+    assert after == before
+    assert len(px.lookup(np.concatenate([toks, [1]])).pairs) == 2
+    kv.release("pin")
+    kv.check_conservation()
+
+
+def test_purge_drops_index_and_returns_blocks_to_free_pool():
+    kv, px = _cache()
+    _commit(kv, px, "a", np.arange(12, dtype=np.int64))
+    kv.release("a")
+    assert px.purge() == 3
+    assert px.cached_blocks() == 0 and kv.free_blocks == 16
+    assert len(px.lookup(np.arange(12, dtype=np.int64)).pairs) == 0
+    kv.check_conservation()
+
+
+def test_stale_pairs_are_revalidated_at_reserve():
+    """A lookup result is a HINT: blocks evicted between lookup and reserve
+    must not be re-attached — reserve truncates at the first stale pair."""
+    kv, px = _cache(num_blocks=8, block_size=4)
+    toks = np.arange(8, dtype=np.int64)
+    _commit(kv, px, "donor", toks)
+    kv.release("donor")
+    hit = px.lookup(np.concatenate([toks, [1]]))
+    assert len(hit.pairs) == 2
+    px.purge()                                    # ...rug pulled
+    kv.reserve("r", 12, shared=hit.pairs)
+    assert kv.length("r") == 0                    # cold admission, no hit
+    kv.release("r")
+    kv.check_conservation()
+
+
+# --------------------------------------------------------------- model level
+def test_prefix_hit_generation_bit_identical_greedy(small_gpt):
+    """Acceptance: the same prompt served cold then warm — the warm request
+    admits past the shared blocks (prefix_hit_tokens > 0) and its output is
+    token-identical to the cold one AND to dense generate()."""
+    m = small_gpt
+    rng = np.random.default_rng(23)
+    prompt = rng.integers(0, 160, 13).astype("int64")
+    ref = _dense_ref(m, prompt, 6)
+    gp = _make(m)
+    try:
+        cold = gp.infer(prompt, timeout=300)
+        assert gp.metrics.get("prefix_hit_tokens") == 0   # nothing indexed yet
+        warm = gp.infer(prompt, timeout=300)
+        np.testing.assert_array_equal(cold, ref)
+        np.testing.assert_array_equal(warm, ref)
+        assert gp.metrics.get("prefix_hit_tokens") == 8   # (13-1)//8 blocks
+        assert gp.kv_cache.blocks_in_use == gp.prefix_cache.cached_blocks()
+        gp.kv_cache.check_conservation()
+    finally:
+        gp.close()
+
+
+def test_multi_turn_chat_extends_indexed_history(small_gpt):
+    """The chat shape: turn 2's prompt = turn 1's FULL output + fresh user
+    tokens. Admission should hit on blocks REGISTERED AT RETIREMENT (prompt
+    + generated), not just on prompt blocks, and stay bit-exact."""
+    m = small_gpt
+    rng = np.random.default_rng(29)
+    p1 = rng.integers(0, 160, 11).astype("int64")
+    gp = _make(m)
+    try:
+        out1 = np.asarray(gp.infer(p1, timeout=300))      # 17 tokens total
+        p2 = np.concatenate([out1, rng.integers(0, 160, 3).astype("int64")])
+        ref2 = _dense_ref(m, p2, 6)
+        out2 = np.asarray(gp.infer(p2, timeout=300))
+        np.testing.assert_array_equal(out2, ref2)
+        # (20-1)//8 = 2 full blocks skipped; block 1 spans tokens 8..16 and
+        # holds GENERATED rows (turn 1's prompt was only 11 tokens), so the
+        # hit proves retire-time registration, not just prompt indexing —
+        # and the bit-exact ref2 proves those shared rows' content
+        assert gp.metrics.get("prefix_hit_tokens") == 16
+        gp.kv_cache.check_conservation()
+    finally:
+        gp.close()
+
+
+def test_prefix_hit_generation_bit_identical_sampled(small_gpt):
+    """Sampled parity: cold and warm schedulers draw the same per-tick seed
+    sequence (one prefill tick each — plen <= prefill_chunk), so sampled
+    outputs must be bit-identical iff the shared KV rows are bit-identical.
+    This is the strongest content check: one wrong row changes the logits
+    and the divergence is immediate."""
+    m = small_gpt
+    rng = np.random.default_rng(31)
+    prompt = rng.integers(0, 160, 13).astype("int64")
+    knobs = dict(temperature=0.9, top_k=4)
+    cold = _make(m, prefill_chunk=16, block_size=4, prefix_cache=False)
+    try:
+        ref = np.asarray(cold.infer(prompt, timeout=300, **knobs))
+    finally:
+        cold.close()
+    warm = _make(m, prefill_chunk=16, block_size=4)
+    try:
+        warm.infer(prompt, timeout=300, **knobs)          # populate index
+        warm._seed = itertools.count(1)                   # realign tick seeds
+        out = np.asarray(warm.infer(prompt, timeout=300, **knobs))
+        np.testing.assert_array_equal(out, ref)
+        assert warm.metrics.get("prefix_hit_tokens") == 12   # (13-1)//4 * 4
+        warm.kv_cache.check_conservation()
+    finally:
+        warm.close()
+
+
+def test_prefix_hit_with_speculative_verify_parity(small_gpt):
+    """Speculation over shared prefix blocks: the verify path's rollback is
+    length bookkeeping only — it must never reach into shared blocks — and
+    greedy spec output stays equal to dense."""
+    m = small_gpt
+    rng = np.random.default_rng(37)
+    prompt = np.tile(rng.integers(0, 160, 5), 3)[:13].astype("int64")
+    ref = _dense_ref(m, prompt, 6)
+    gp = _make(m, spec_k=2)
+    try:
+        np.testing.assert_array_equal(gp.infer(prompt, timeout=300), ref)
+        np.testing.assert_array_equal(gp.infer(prompt, timeout=300), ref)
+        assert gp.metrics.get("prefix_hit_tokens") == 8
+        gp.kv_cache.check_conservation()
+    finally:
+        gp.close()
+
+
+def test_prefix_observability_counters_and_spans(small_gpt):
+    """Satellite: `prefix_lookup` span on the request trace; the
+    prefix-tier gauges partition cached/shared/indexed; hit counter in both
+    the serving snapshot and the Prometheus registry."""
+    from paddle_tpu.observability.metrics import render_prometheus
+
+    m = small_gpt
+    rng = np.random.default_rng(41)
+    prompt = rng.integers(0, 160, 13).astype("int64")
+    gp = _make(m)
+    try:
+        gp.infer(prompt, timeout=300, trace_id="feedfacefeedface")
+        gp.infer(prompt, timeout=300, trace_id="c0ffeec0ffeec0ff")
+        names = {s.name for s in gp.tracer.trace("c0ffeec0ffeec0ff")}
+        assert "prefix_lookup" in names
+        hit_span = [s for s in gp.tracer.trace("c0ffeec0ffeec0ff")
+                    if s.name == "prefix_lookup"][0]
+        assert hit_span.tags.get("hit_tokens") == 8
+        text = render_prometheus(gp.metrics.registry)
+        assert "paddle_prefix_hit_tokens_total" in text
+        assert 'paddle_prefix_cache_blocks{component="continuous",' in text
+        assert gp.metrics.snapshot()["prefix_hit_tokens"] == 8
+    finally:
+        gp.close()
+
+
+# -------------------------------------------------------------------- chaos
+@pytest.mark.chaos
+def test_chaos_lookup_fault_degrades_to_cold_miss(small_gpt):
+    """`kv.prefix_match` satellite: an injected lookup error must read as a
+    MISS — the request admits cold, completes bit-exact, and the next
+    request hits again (the index itself is untouched)."""
+    from paddle_tpu.inference.faults import FaultInjector
+
+    m = small_gpt
+    rng = np.random.default_rng(43)
+    prompt = rng.integers(0, 160, 13).astype("int64")
+    ref = _dense_ref(m, prompt, 6)
+    f = FaultInjector()
+    gp = _make(m, faults=f)
+    try:
+        gp.infer(prompt, timeout=300)
+        f.install("kv.prefix_match", error=RuntimeError("index chaos"))
+        np.testing.assert_array_equal(gp.infer(prompt, timeout=300), ref)
+        assert gp.metrics.get("prefix_hit_tokens") == 0   # degraded cold
+        np.testing.assert_array_equal(gp.infer(prompt, timeout=300), ref)
+        assert gp.metrics.get("prefix_hit_tokens") == 8   # healed
+        gp.kv_cache.check_conservation()
+    finally:
+        gp.close()
+
+
+@pytest.mark.chaos
+def test_chaos_eviction_racing_admission_sheds_cleanly(small_gpt):
+    """`kv.prefix_evict` satellite: reclaim stalls + fails inside reserve's
+    atomic section while concurrent admissions fight over a small pool.
+    Every client reaches exactly one terminal outcome, served outputs are
+    well-formed, and the pool conserves with the witness armed."""
+    from paddle_tpu.inference.faults import FaultInjector
+    from paddle_tpu.inference.resilience import Rejected, ServiceUnavailable
+
+    m = small_gpt
+    rng = np.random.default_rng(47)
+    prompts = [rng.integers(0, 160, n).astype("int64")
+               for n in (13, 9, 13, 11, 9, 13)]
+    f = FaultInjector()
+    # pool sized so admissions only fit by reclaiming parked prefix blocks
+    gp = _make(m, max_slots=2, num_blocks=8, block_size=4,
+               max_seq_len=20, faults=f, max_defers=8)
+    served, failed = [], []
+    lock = threading.Lock()
+    try:
+        gp.infer(prompts[0], timeout=300)         # park some indexed blocks
+        f.install("kv.prefix_evict", delay=0.05, times=2)
+        f.install("kv.prefix_evict", error=RuntimeError("evict chaos"),
+                  after=2, times=2)
+
+        def client(i):
+            try:
+                out = np.asarray(gp.infer(prompts[i], timeout=300))
+                with lock:
+                    served.append((i, out))
+            except (Rejected, ServiceUnavailable, RuntimeError,
+                    TimeoutError, CacheOutOfBlocks) as e:
+                with lock:
+                    failed.append((i, e))
+
+        ts = [threading.Thread(target=client, args=(i,))
+              for i in range(len(prompts))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=300)
+        assert not any(t.is_alive() for t in ts)
+        assert len(served) + len(failed) == len(prompts)   # exactly once
+        for i, out in served:
+            assert out.shape == (len(prompts[i]) + 6,)
+            np.testing.assert_array_equal(out[:len(prompts[i])], prompts[i])
+        assert f.fired("kv.prefix_evict") >= 1
+        gp.kv_cache.check_conservation()
+        assert gp.kv_cache.blocks_in_use == gp.prefix_cache.cached_blocks()
+    finally:
+        gp.close()
+
+
+# ---------------------------------------------------------------- streaming
+def test_infer_stream_chunks_concat_to_buffered_suffix(small_gpt):
+    """Streaming changes WHEN tokens arrive, never which: the chunk concat
+    equals infer()'s generated suffix, chunks land at tick boundaries (more
+    than one flush for a multi-tick decode), and the slot is reclaimed."""
+    m = small_gpt
+    rng = np.random.default_rng(53)
+    prompt = rng.integers(0, 160, 7).astype("int64")
+    ref = _dense_ref(m, prompt, 6)
+    gp = _make(m)
+    try:
+        chunks = [np.asarray(c, np.int64)
+                  for c in gp.infer_stream(prompt, timeout=300)]
+        assert len(chunks) >= 2                   # tick-boundary delivery
+        np.testing.assert_array_equal(np.concatenate(chunks), ref[7:])
+        assert gp.pending() == 0
+        gp.kv_cache.check_conservation()
+    finally:
+        gp.close()
+
+
+def test_stream_abandoned_mid_generation_cancels_cleanly(small_gpt):
+    """A client that walks away (generator closed early) must cancel the
+    in-flight sequence and free its blocks — no leak, no hang."""
+    m = small_gpt
+    rng = np.random.default_rng(59)
+    prompt = rng.integers(0, 160, 7).astype("int64")
+    gp = _make(m)
+    try:
+        it = gp.infer_stream(prompt, timeout=300)
+        next(it)                                  # first flush arrives...
+        it.close()                                # ...client hangs up
+        deadline = 30.0
+        import time as _time
+        t0 = _time.monotonic()
+        while gp.pending() and _time.monotonic() - t0 < deadline:
+            _time.sleep(0.01)
+        assert gp.pending() == 0
+        assert gp.metrics.get("timeouts") >= 1    # abandoned == client loss
+        gp.kv_cache.check_conservation()
+    finally:
+        gp.close()
+
+
+def _sse_events(body):
+    """Parse an SSE byte stream into (id, event, data-dict) triples."""
+    out = []
+    for block in body.decode().split("\n\n"):
+        if not block.strip():
+            continue
+        fields = dict(line.split(": ", 1) for line in block.split("\n"))
+        out.append((fields["id"], fields["event"],
+                    json.loads(fields["data"])))
+    return out
+
+
+def test_server_sse_stream_parity_and_trace_ids(small_gpt):
+    """Wire-level acceptance: /generate with Accept: text/event-stream
+    delivers the SAME token sequence as the buffered response; every event
+    carries the trace id in the SSE id field AND the JSON payload, matching
+    the X-Trace-Id response header."""
+    from paddle_tpu.inference.serving import InferenceServer
+
+    m = small_gpt
+    rng = np.random.default_rng(61)
+    prompt = rng.integers(0, 160, 7).astype("int64")
+    ref = _dense_ref(m, prompt, 6)
+    gp = _make(m)
+    srv = InferenceServer(None, batching=False, generator=gp).start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        buf = io.BytesIO()
+        np.savez(buf, ids=prompt)
+        req = urllib.request.Request(
+            base + "/generate", data=buf.getvalue(),
+            headers={"Accept": "text/event-stream"})
+        r = urllib.request.urlopen(req, timeout=120)
+        assert r.status == 200
+        assert r.headers["Content-Type"] == "text/event-stream"
+        tid = r.headers["X-Trace-Id"]
+        events = _sse_events(r.read())
+        assert [e for _, e, _ in events][-1] == "done"
+        toks = []
+        for eid, event, data in events:
+            assert eid == tid and data["trace_id"] == tid
+            if event == "tokens":
+                toks.extend(data["tokens"])
+        np.testing.assert_array_equal(np.asarray(toks, np.int64), ref[7:])
+        assert events[-1][2]["generated"] == 6
+        assert events[-1][2]["prompt_len"] == 7
+    finally:
+        srv.stop(drain_timeout=10)
+
+
+def test_server_stream_gates_and_errors(small_gpt):
+    """X-Stream: sse against a non-streaming generator is a 400 (a REAL
+    status — admission errors must beat the first flushed byte); malformed
+    X-Stream is a 400; X-Stream: off suppresses an Accept header."""
+    from paddle_tpu.inference.serving import (
+        GenerateBatchingPredictor, InferenceServer,
+    )
+
+    m = small_gpt
+    rng = np.random.default_rng(67)
+    prompt = rng.integers(0, 160, 5).astype("int64")
+    fixed = GenerateBatchingPredictor(m, max_batch_size=2, max_delay_ms=5,
+                                      max_new_tokens=6, decode_kernel="xla",
+                                      block_size=8, num_blocks=32)
+    srv = InferenceServer(None, batching=False, generator=fixed).start()
+    base = f"http://127.0.0.1:{srv.port}"
+
+    def post(headers):
+        buf = io.BytesIO()
+        np.savez(buf, ids=prompt)
+        req = urllib.request.Request(base + "/generate", data=buf.getvalue(),
+                                     headers=headers)
+        try:
+            return urllib.request.urlopen(req, timeout=120).status
+        except urllib.error.HTTPError as e:
+            return e.code
+
+    try:
+        assert post({"X-Stream": "sse"}) == 400       # buffering generator
+        assert post({"X-Stream": "nope"}) == 400      # malformed opt-in
+        assert post({"Accept": "text/event-stream",
+                     "X-Stream": "off"}) == 200       # explicit override
+        assert post({}) == 200                        # buffered default
+    finally:
+        srv.stop(drain_timeout=10)
