@@ -26,6 +26,13 @@ One place builds the programs the CLI ``--self-check``, the bench
   draft chunk in one forward and runs rejection sampling in-program. Same
   deploy-blocker standard as the decode tick — the acceptance pattern must
   never leak into the program shape.
+* ``gpt_prefill_chunk_tp`` / ``gpt_decode_step_tp`` / ``gpt_verify_step_tp``
+  — the SAME three step programs traced under the ``("dp","tp")`` serving
+  mesh (distributed/mesh.py ``serving_mesh``): tp shards the qkv/ffn/
+  embedding weights and the paged pool's head axis, and the split-KV kernel
+  runs inside a shard_map over tp. These entries declare the deployment
+  axes, so the collective-axis rule is their deploy gate: a collective
+  bound to any axis the serving mesh doesn't carry is a HIGH finding.
 
 Smoke sizes on purpose: lint findings are properties of the GRAPH, not the
 weights, and the same rules fire on a 2-layer 64-wide GPT as on 350M — so
@@ -174,7 +181,29 @@ def _continuous_smoke():
     return model, kv, tbl, ids, S, C, NEW, T, jnp
 
 
-def gpt_prefill_chunk_report(thresholds=None, allowlist=None):
+def _under_serving_mesh(report_fn, thresholds, allowlist):
+    """Run a step-program report under the ("dp","tp") serving mesh.
+
+    tp=2 when the process has the devices (tier-1 sets
+    xla_force_host_platform_device_count=8; a real TPU slice always
+    qualifies), else tp=1 — the entry still lints with the deployment axes
+    declared, just without the sharding. The previous global mesh is
+    restored afterwards so entry order never leaks mesh state."""
+    import jax
+
+    from paddle_tpu.distributed.mesh import get_mesh, serving_mesh, set_mesh
+
+    prev = get_mesh()
+    tp = 2 if len(jax.devices()) >= 2 else 1
+    serving_mesh(dp=1, tp=tp)
+    try:
+        return report_fn(thresholds=thresholds, allowlist=allowlist,
+                         _tp=True)
+    finally:
+        set_mesh(prev)
+
+
+def gpt_prefill_chunk_report(thresholds=None, allowlist=None, _tp=False):
     import jax
 
     from .core import analyze
@@ -193,14 +222,24 @@ def gpt_prefill_chunk_report(thresholds=None, allowlist=None):
         jnp.zeros((S,), jnp.float32), jnp.zeros((S,), jnp.int32),
         tuple(kv.k_pages), tuple(kv.v_pages),
         jax.random.key(0),
-        _name="gpt.decode.paged_prefill_chunk",
+        _name="gpt.decode.paged_prefill_chunk" + ("_tp" if _tp else ""),
+        _mesh_axes=("dp", "tp") if _tp else None,
         _arg_labels=("state", "chunk", "offsets", "chunk_lens", "tables",
                      "temperatures", "top_ks", "k_pages", "v_pages",
                      "rng_key"),
         _thresholds=thresholds, _allowlist=allowlist)
 
 
-def gpt_decode_step_report(thresholds=None, allowlist=None):
+def gpt_prefill_chunk_tp_report(thresholds=None, allowlist=None):
+    """Chunked prefill traced under the ("dp","tp") serving mesh: tp shards
+    the qkv/ffn/embedding weights and the paged pool's head axis. The
+    collective-axis rule is the deploy gate — every collective GSPMD or the
+    split-KV shard_map inserts must answer to a declared deployment axis."""
+    return _under_serving_mesh(gpt_prefill_chunk_report, thresholds,
+                               allowlist)
+
+
+def gpt_decode_step_report(thresholds=None, allowlist=None, _tp=False):
     import jax
 
     from .core import analyze
@@ -221,11 +260,22 @@ def gpt_decode_step_report(thresholds=None, allowlist=None):
         jnp.asarray(lmax, jnp.int32), jnp.asarray(tbl, jnp.int32),
         jnp.zeros((S,), jnp.float32), jnp.zeros((S,), jnp.int32),
         tuple(kv.k_pages), tuple(kv.v_pages), jax.random.key(0),
-        _name="gpt.decode.paged_step",
+        _name="gpt.decode.paged_step" + ("_tp" if _tp else ""),
+        _mesh_axes=("dp", "tp") if _tp else None,
         _arg_labels=("state", "tokens", "lengths", "active", "max_lens",
                      "tables", "temperatures", "top_ks", "k_pages",
                      "v_pages", "rng_key"),
         _thresholds=thresholds, _allowlist=allowlist)
+
+
+def gpt_decode_step_tp_report(thresholds=None, allowlist=None):
+    """The decode tick under the ("dp","tp") serving mesh — the program a
+    tensor-parallel serving replica launches per token. The split-KV kernel
+    runs head-local inside a shard_map over tp (no collective inside; the
+    only cross-chip exchange is the sampled-logit gather GSPMD inserts after
+    the vocab-sharded lm_head), so the collective-axis gate here is what
+    stops an mp-named training program from reaching a tp-named mesh."""
+    return _under_serving_mesh(gpt_decode_step_report, thresholds, allowlist)
 
 
 def gpt_prefill_prefix_report(thresholds=None, allowlist=None):
@@ -292,7 +342,7 @@ def gpt_prefill_prefix_report(thresholds=None, allowlist=None):
         _thresholds=thresholds, _allowlist=allowlist)
 
 
-def gpt_verify_step_report(thresholds=None, allowlist=None):
+def gpt_verify_step_report(thresholds=None, allowlist=None, _tp=False):
     import jax
 
     from .core import analyze
@@ -317,11 +367,20 @@ def gpt_verify_step_report(thresholds=None, allowlist=None):
         jnp.asarray(tbl, jnp.int32),
         jnp.zeros((S,), jnp.float32), jnp.zeros((S,), jnp.int32),
         tuple(kv.k_pages), tuple(kv.v_pages), jax.random.key(0),
-        _name="gpt.decode.paged_verify_step",
+        _name="gpt.decode.paged_verify_step" + ("_tp" if _tp else ""),
+        _mesh_axes=("dp", "tp") if _tp else None,
         _arg_labels=("state", "chunk", "offsets", "draft_lens", "active",
                      "max_lens", "tables", "temperatures", "top_ks",
                      "k_pages", "v_pages", "rng_key"),
         _thresholds=thresholds, _allowlist=allowlist)
+
+
+def gpt_verify_step_tp_report(thresholds=None, allowlist=None):
+    """The speculative verifier under the ("dp","tp") serving mesh — same
+    deploy-blocker standard as the sharded decode tick; rejection sampling
+    runs on the gathered logits so acceptance never crosses chips."""
+    return _under_serving_mesh(gpt_verify_step_report, thresholds,
+                               allowlist)
 
 
 ZOO_PROGRAMS = {
@@ -333,6 +392,9 @@ ZOO_PROGRAMS = {
     "gpt_prefill_prefix": gpt_prefill_prefix_report,
     "gpt_decode_step": gpt_decode_step_report,
     "gpt_verify_step": gpt_verify_step_report,
+    "gpt_prefill_chunk_tp": gpt_prefill_chunk_tp_report,
+    "gpt_decode_step_tp": gpt_decode_step_tp_report,
+    "gpt_verify_step_tp": gpt_verify_step_tp_report,
 }
 
 

@@ -5,7 +5,8 @@ from .env import (  # noqa: F401
     ParallelEnv, get_rank, get_world_size, init_parallel_env, is_initialized,
 )
 from .mesh import (  # noqa: F401
-    Partial, Placement, ProcessMesh, Replicate, Shard, auto_mesh, get_mesh, set_mesh,
+    Partial, Placement, ProcessMesh, Replicate, Shard, SpecLayout, auto_mesh,
+    get_mesh, mesh_axis_size, serving_mesh, set_mesh,
 )
 from .api import (  # noqa: F401
     DistAttr, ReduceType, ShardingStage1, ShardingStage2, ShardingStage3,

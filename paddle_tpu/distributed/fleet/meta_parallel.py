@@ -25,13 +25,30 @@ from ..api import shard_tensor
 from ..mesh import Replicate, Shard, constrain, get_mesh
 
 
+# The model-parallel axis answers to two names: 'mp' on training meshes
+# (reference fleet naming) and 'tp' on the ("dp","tp") serving mesh
+# (ISSUE-12 mesh serving). Same layer library either way.
+_MP_AXIS_NAMES = ("mp", "tp")
+
+
+def _mp_axis_name(mesh):
+    if mesh is None:
+        return None
+    for name in _MP_AXIS_NAMES:
+        if name in mesh.dim_names:
+            return name
+    return None
+
+
 def _mp_axis_index(mesh):
-    return mesh.dim_names.index("mp") if mesh and "mp" in mesh.dim_names else None
+    name = _mp_axis_name(mesh)
+    return mesh.dim_names.index(name) if name is not None else None
 
 
 def _mark_mp_shard(param, tensor_dim):
-    """Annotate a parameter as sharded along 'mp' on tensor_dim (device_put if a mesh
-    with an mp axis exists and the dim divides)."""
+    """Annotate a parameter as sharded along the model-parallel axis ('mp' or
+    'tp') on tensor_dim (device_put if a mesh with such an axis exists and the
+    dim divides)."""
     mesh = get_mesh()
     if mesh is None:
         return param
@@ -83,10 +100,12 @@ class ColumnParallelLinear(Layer):
     def forward(self, x):
         out = F.linear(x, self.weight, self.bias)
         if not self.gather_output:
-            # keep activation sharded on last dim along mp (targets the stage
-            # sub-mesh inside pipeline programs via the compute-mesh override)
+            # keep activation sharded on last dim along the model-parallel
+            # axis (targets the stage sub-mesh inside pipeline programs via
+            # the compute-mesh override; `constrain` drops whichever of the
+            # two names the active mesh doesn't carry)
             out._value = constrain(
-                out._value, [None] * (out.ndim - 1) + ["mp"])
+                out._value, [None] * (out.ndim - 1) + [("mp", "tp")])
         return out
 
 

@@ -285,3 +285,56 @@ def constrain(val, entries, force=False):
         return val
     return _jax.lax.with_sharding_constraint(
         val, NamedSharding(jm, PartitionSpec(*kept)))
+
+
+def mesh_axis_size(name, jax_mesh=None) -> int:
+    """Size of a named axis on the given (default: current compute) jax mesh;
+    1 when there is no mesh or the axis is absent — callers can gate sharded
+    paths on `mesh_axis_size("tp") > 1` without null checks."""
+    jm = jax_mesh if jax_mesh is not None else current_jax_mesh()
+    if jm is None or name not in jm.axis_names:
+        return 1
+    return int(dict(zip(jm.axis_names, jm.devices.shape))[name])
+
+
+# ------------------------------------------------------------ serving layouts
+class SpecLayout:
+    """Canonical partition entries for the ("dp","tp") serving mesh (SNIPPETS
+    SpecLayout pattern): tp rides the qkv/ffn/embedding tensor axes, the paged
+    KV pool head-shards on its leading axis, and everything slot-shaped stays
+    replicated — dp carries no in-program sharding because data parallelism
+    lives at the scheduler-replica level (`ReplicaFleet`)."""
+
+    def __init__(self, dp_axis="dp", tp_axis="tp"):
+        self.dp_axis = dp_axis
+        self.tp_axis = tp_axis
+
+    def kv_pool(self):
+        """[Hkv, pages, block, head_dim] — the pool's leading axis IS the KV
+        head axis, so head-sharding is a leading-dim shard."""
+        return (self.tp_axis, None, None, None)
+
+    def heads(self, ndim=4, head_dim=2):
+        """Head-major activations, e.g. q [B, S, Hq, D]."""
+        entries = [None] * ndim
+        entries[head_dim] = self.tp_axis
+        return tuple(entries)
+
+    def logits(self):
+        """[slots, vocab] logits before sampling: vocab-sharded over tp (the
+        tied lm_head is a VocabParallelEmbedding row shard)."""
+        return (None, self.tp_axis)
+
+    def replicated(self, ndim):
+        return (None,) * ndim
+
+
+def serving_mesh(dp=1, tp=1, *, set_global=True) -> ProcessMesh:
+    """Build (and by default install as the global mesh) the ("dp","tp")
+    serving mesh over the first dp*tp devices. tp shards the step programs'
+    weights and KV pool; dp is the replica-fleet axis."""
+    ids = np.arange(dp * tp).reshape(dp, tp)
+    m = ProcessMesh(ids, ["dp", "tp"])
+    if set_global:
+        set_mesh(m)
+    return m

@@ -93,7 +93,7 @@ class PagedKVCache:
     """
 
     def __init__(self, num_layers, num_kv_heads, head_dim, block_size=128,
-                 num_blocks=64, dtype="bfloat16", faults=None):
+                 num_blocks=64, dtype="bfloat16", faults=None, mesh=None):
         import jax.numpy as jnp
 
         self.num_layers = int(num_layers)
@@ -110,6 +110,24 @@ class PagedKVCache:
                         for _ in range(self.num_layers)]
         self.v_pages = [jnp.zeros(shape, self.dtype)
                         for _ in range(self.num_layers)]
+        # ("dp","tp") serving mesh: head-shard the pools over tp so each chip
+        # resident-holds 1/tp of the KV bytes; step programs keep the layout
+        # (commit() stores jit outputs whose shardings propagate from these)
+        self.tp_sharded = False
+        if mesh is None:
+            from ..distributed.mesh import get_mesh
+            mesh = get_mesh()
+        jm = getattr(mesh, "jax_mesh", mesh)  # ProcessMesh | jax Mesh | None
+        if jm is not None and "tp" in getattr(jm, "axis_names", ()):
+            from ..distributed.mesh import SpecLayout, mesh_axis_size
+            tp = mesh_axis_size("tp", jm)
+            if tp > 1 and self.num_kv_heads % tp == 0:
+                import jax
+                from jax.sharding import NamedSharding, PartitionSpec
+                sh = NamedSharding(jm, PartitionSpec(*SpecLayout().kv_pool()))
+                self.k_pages = [jax.device_put(p, sh) for p in self.k_pages]
+                self.v_pages = [jax.device_put(p, sh) for p in self.v_pages]
+                self.tp_sharded = True
         self.allocator = BlockAllocator(self.num_blocks, faults=faults)
         self._requests: dict = {}
         self._clock = itertools.count()
@@ -135,6 +153,19 @@ class PagedKVCache:
 
     def blocks_for(self, seq_len: int) -> int:
         return max(1, math.ceil(seq_len / self.block_size))
+
+    def pool_bytes(self) -> int:
+        """Logical pool bytes (K + V across all layers), sharding-independent."""
+        return sum(int(p.nbytes) for p in self.k_pages + self.v_pages)
+
+    def per_chip_pool_bytes(self) -> int:
+        """Resident KV bytes on one chip: pool_bytes()/tp under tp
+        head-sharding, pool_bytes() unsharded (the ISSUE-12 residency gate)."""
+        total = 0
+        for p in self.k_pages + self.v_pages:
+            shards = getattr(p, "addressable_shards", None)
+            total += int(shards[0].data.nbytes) if shards else int(p.nbytes)
+        return total
 
     def attach_prefix_cache(self, prefix):
         """Wire a PrefixCache into release/evict: refcount-zero indexed
@@ -182,6 +213,12 @@ class PagedKVCache:
             "Finished-but-retained requests evicted LRU to cover new "
             "reservations", labels=("pool",)).labels(pool).set_function(
                 lambda: self.evictions)
+        registry.gauge(
+            "paddle_kv_pool_per_chip_bytes",
+            "KV pool bytes resident PER CHIP — 1/tp of the logical pool "
+            "when the pool is head-sharded over the serving mesh's tp axis",
+            labels=("pool",)).labels(pool).set_function(
+                self.per_chip_pool_bytes)
         return self
 
     # ----------------------------------------------------------- allocation

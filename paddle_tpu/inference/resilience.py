@@ -63,9 +63,16 @@ class ServerBusy(Rejected):
 
 
 class ServiceUnavailable(Rejected):
-    """Not serving (draining, breaker open, worker dead) -> HTTP 503."""
+    """Not serving (draining, breaker open, worker dead) -> HTTP 503.
 
-    status = 503
+    ``permanent=True`` marks a 503 no amount of client retrying will fix —
+    today that is exactly one case: a Supervisor whose restart budget is
+    spent (the worker is dead for good). The ReplicaFleet router keys replica
+    death off this flag instead of string-matching the message."""
+
+    def __init__(self, msg, retry_after=None, permanent=False):
+        super().__init__(msg, retry_after=retry_after)
+        self.permanent = bool(permanent)
 
 
 class Deadline:
@@ -206,6 +213,12 @@ class Supervisor:
     def alive(self) -> bool:
         return self.thread is not None and self.thread.is_alive()
 
+    def dead(self) -> bool:
+        """Permanently down: the worker is not running and the restart
+        budget is spent (heal() would raise). The fleet's replica-state
+        gauge reads this without triggering a heal."""
+        return not self.alive() and self.restarts >= self.max_restarts
+
     def heal(self) -> bool:
         """Restart the worker if it died. True if a restart happened; raises
         ServiceUnavailable once the restart budget is spent (at that point
@@ -218,7 +231,7 @@ class Supervisor:
             if self.restarts >= self.max_restarts:
                 raise ServiceUnavailable(
                     f"{self.name} dead after {self.restarts} restarts",
-                    retry_after=None)
+                    retry_after=None, permanent=True)
             self.restarts += 1
             if self.backoff:
                 self._sleep(min(self.backoff * (2 ** (self.restarts - 1)),

@@ -49,7 +49,8 @@ from .resilience import (
 )
 
 __all__ = ["BatchingPredictor", "GenerateBatchingPredictor",
-           "ContinuousGenerateBatchingPredictor", "InferenceServer"]
+           "ContinuousGenerateBatchingPredictor", "InferenceServer",
+           "ReplicaFleet"]
 
 
 def __getattr__(name):
@@ -159,8 +160,13 @@ class BatchingPredictor:
 
     def __init__(self, predictor, max_batch_size=8, max_delay_ms=2.0,
                  faults=None, admission=None, breaker=None, max_retries=1,
-                 max_restarts=5, tracer=None, registry=None):
+                 max_restarts=5, tracer=None, registry=None, component=None):
         self.predictor = predictor
+        # instance override of the prometheus `component` label: replicas in
+        # a ReplicaFleet share one registry, so each needs a distinct name
+        # ("r0", "r1", ...) or their series would merge
+        if component is not None:
+            self._component = str(component)
         self.max_batch_size = int(max_batch_size)
         self.max_delay = max_delay_ms / 1000.0
         self.max_retries = int(max_retries)
@@ -184,13 +190,17 @@ class BatchingPredictor:
         # documented-atomic type; a plain list.append is too under the GIL,
         # but the contract is explicit this way)
         self.batch_sizes: collections.deque = collections.deque()
-        self._sup = Supervisor(self._make_thread, name=type(self).__name__,
+        # component-qualified names: a ReplicaFleet runs N of these, and an
+        # unqualified thread dump / permanent-503 message can't say WHICH
+        # replica died
+        self._sup = Supervisor(self._make_thread,
+                               name=f"{type(self).__name__}[{self._component}]",
                                max_restarts=max_restarts)
         self._sup.start()
 
     def _make_thread(self):
         return threading.Thread(target=self._thread_main, daemon=True,
-                                name="batching-predictor")
+                                name=f"batching-predictor[{self._component}]")
 
     def _thread_main(self):
         try:
@@ -531,7 +541,7 @@ class GenerateBatchingPredictor(BatchingPredictor):
                  max_new_tokens=32, kv_cache=None, decode_kernel="pallas",
                  block_size=32, num_blocks=64, faults=None, admission=None,
                  breaker=None, max_retries=1, max_defers=8, max_restarts=5,
-                 tracer=None, registry=None):
+                 tracer=None, registry=None, component=None):
         spec = tuple(int(x) for x in model._decode_cache_spec())
         if kv_cache is None:
             from .kv_cache import PagedKVCache
@@ -556,7 +566,8 @@ class GenerateBatchingPredictor(BatchingPredictor):
                          max_delay_ms=max_delay_ms, faults=faults,
                          admission=admission, breaker=breaker,
                          max_retries=max_retries, max_restarts=max_restarts,
-                         tracer=tracer, registry=registry)
+                         tracer=tracer, registry=registry,
+                         component=component)
         # pool state scrapes through the shared registry (live/free/evictable
         # gauges + eviction counter), decode launches feed the histogram below
         kv_cache.bind_metrics(self.metrics.registry, pool=self._component)
@@ -882,11 +893,21 @@ class InferenceServer:
                 if path == "/health":
                     self._reply(200, b"ok")
                 elif path == "/readyz":
-                    if outer._ready.is_set() and not outer._draining.is_set():
+                    # fleet-aware: a ReplicaFleet generator exposes ready()
+                    # (any dispatchable replica) — a fleet with every
+                    # replica dead/draining flips /readyz to 503 even
+                    # though the HTTP loop itself is up
+                    workers_ready = all(
+                        w.ready() for w in (outer.batcher, outer.generator)
+                        if w is not None and hasattr(w, "ready"))
+                    if (outer._ready.is_set()
+                            and not outer._draining.is_set()
+                            and workers_ready):
                         self._reply(200, b"ready")
                     else:
                         body = (b"draining" if outer._draining.is_set()
-                                else b"not started")
+                                else b"no ready replicas"
+                                if outer._ready.is_set() else b"not started")
                         self._reply(503, body, [("Retry-After", "1")])
                 elif path == "/metrics":
                     accept = self.headers.get("Accept", "")
@@ -908,6 +929,9 @@ class InferenceServer:
                         snap["batcher"] = outer.batcher.metrics.snapshot()
                     if outer.generator is not None:
                         snap["generator"] = outer.generator.metrics.snapshot()
+                        if hasattr(outer.generator, "replica_states"):
+                            snap["replicas"] = \
+                                outer.generator.replica_states()
                     self._reply(200, json.dumps(snap).encode(),
                                 [("Content-Type", "application/json")])
                 else:
@@ -1093,3 +1117,373 @@ class InferenceServer:
             w.close()
         if self._thread.is_alive():
             self._thread.join(timeout=2)
+
+
+# ---------------------------------------------------------------------------
+# Replica fleet: data-parallel serving over N continuous schedulers
+# ---------------------------------------------------------------------------
+class _Replica:
+    """One fleet member: a continuous scheduler plus its routing state.
+
+    `state` is the FLEET's routing view ("ready" | "draining" | "dead"), not
+    the predictor's own lifecycle — a draining replica still finishes its
+    queued work, the router just stops feeding it."""
+
+    __slots__ = ("name", "predictor", "state")
+
+    def __init__(self, name, predictor):
+        self.name = name
+        self.predictor = predictor
+        self.state = "ready"
+
+
+class ReplicaFleet:
+    """Least-loaded router over N data-parallel scheduler replicas.
+
+    The mesh-serving split of labor (ISSUE-12): tensor parallelism lives
+    INSIDE each replica's step programs (the tp axis shards weights and the
+    paged KV pool head-wise; GSPMD + the shard_map'd split-KV kernel insert
+    the collectives), while data parallelism lives HERE, entirely on the
+    host — N independent ``ContinuousGenerateBatchingPredictor`` replicas
+    over one shared model, so every replica reuses the same compiled step
+    programs (replica admit/retire/kill never recompiles; pinned by the
+    bench recompile audit) while holding its own KV pool and slot state.
+
+    Routing contract:
+
+    * Admission happens ONCE at the fleet door (aggregate pending depth);
+      per-replica admission still applies at dispatch and a busy replica
+      fails over to the next-least-loaded sibling.
+    * A replica whose circuit breaker is OPEN is skipped by reading
+      ``breaker.state`` — never ``allow()``, which would consume the
+      half-open probe the replica's own admission path needs to close it.
+    * A ``ServiceUnavailable(permanent=True)`` (supervisor restart budget
+      spent — the worker is dead for good) marks the replica dead and
+      re-dispatches to a sibling. Clients parked in a dead replica's
+      ``_await``/``_stream_pump`` surface the same permanent 503 through
+      their heal loop, so the dead replica's queued requests re-enter this
+      router and land on survivors; the terminal-outcome CAS on the
+      original request already fired (``_fail``), so re-dispatch is a NEW
+      request — exactly-once terminals per request object hold throughout.
+    * Draining is routing-only until ``retire_replica``: ``drain_replica``
+      just stops new dispatches (queued work finishes), ``undrain_replica``
+      reverses it, ``retire_replica`` drains, waits, and closes.
+
+    Observability: ``paddle_fleet_replicas{state=...}`` gauge (scrape-time
+    membership counts), ``paddle_fleet_dispatch_total{replica,outcome}``
+    counter, and a ``fleet_dispatch`` child span per dispatch attempt on a
+    trace shared (same trace id) with the replica-side request spans.
+    Fleet-level ``ServingMetrics`` (component="fleet") keeps the same
+    conservation contract as every other component:
+    accepted == completed + failed + timeouts."""
+
+    supports_sampler_knobs = True   # replicas are continuous schedulers
+    supports_streaming = True
+
+    def __init__(self, replicas, *, admission=None, registry=None,
+                 tracer=None, clock=time.monotonic):
+        self._lock = make_lock("serving.ReplicaFleet._lock")
+        self._replicas = list(replicas)
+        self._next_id = len(self._replicas)
+        self._clock = clock
+        self.tracer = tracer if tracer is not None else Tracer()
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.metrics = ServingMetrics(registry=self.registry,
+                                      component="fleet")
+        self.admission = admission if admission is not None \
+            else AdmissionController()
+        self._draining = threading.Event()
+        # build()-made fleets can mint new replicas (admit) on demand
+        self._model = None
+        self._replica_kwargs = {}
+        g = self.registry.gauge(
+            "paddle_fleet_replicas",
+            "Replica-fleet membership by routing state",
+            labels=("state",))
+        for st in ("ready", "draining", "dead"):
+            g.labels(st).set_function(
+                lambda s=st: float(self._count_state(s)))
+        self._dispatch_total = self.registry.counter(
+            "paddle_fleet_dispatch_total",
+            "Fleet dispatch attempts by replica and outcome",
+            labels=("replica", "outcome"))
+
+    @classmethod
+    def build(cls, model, n_replicas=2, *, registry=None, tracer=None,
+              admission=None, replica_kwargs=None, **kwargs):
+        """Construct a fleet of ``n_replicas`` continuous schedulers over ONE
+        shared model (shared step-program caches -> zero recompiles across
+        the fleet) and one shared metrics registry/tracer, each replica
+        labelled ``r0``, ``r1``, ... via the ``component`` override.
+        ``replica_kwargs`` (a list of dicts) overlays per-replica settings
+        on the common ``**kwargs`` (e.g. a per-replica FaultInjector for the
+        chaos suite)."""
+        from .scheduler import ContinuousGenerateBatchingPredictor
+
+        registry = registry if registry is not None else MetricsRegistry()
+        tracer = tracer if tracer is not None else Tracer()
+        per = list(replica_kwargs) if replica_kwargs else []
+        replicas = []
+        for i in range(int(n_replicas)):
+            kw = dict(kwargs)
+            if i < len(per) and per[i]:
+                kw.update(per[i])
+            name = f"r{i}"
+            replicas.append(_Replica(name, ContinuousGenerateBatchingPredictor(
+                model, registry=registry, tracer=tracer, component=name,
+                **kw)))
+        fleet = cls(replicas, admission=admission, registry=registry,
+                    tracer=tracer)
+        fleet._model = model
+        fleet._replica_kwargs = dict(kwargs)
+        return fleet
+
+    # ------------------------------------------------------------ membership
+    def _snapshot(self):
+        with self._lock:
+            return list(self._replicas)
+
+    def _by_name(self, name) -> _Replica:
+        for rep in self._snapshot():
+            if rep.name == name:
+                return rep
+        raise KeyError(f"no replica named {name!r}")
+
+    def _refresh(self, rep) -> str:
+        """Routing state with supervisor-death folded in (non-healing)."""
+        if rep.state != "dead" and rep.predictor._sup.dead():
+            rep.state = "dead"
+        return rep.state
+
+    def _count_state(self, state) -> int:
+        return sum(1 for rep in self._snapshot()
+                   if self._refresh(rep) == state)
+
+    def replica_states(self) -> dict:
+        """{name: "ready" | "draining" | "dead"} — the /readyz payload."""
+        return {rep.name: self._refresh(rep) for rep in self._snapshot()}
+
+    def add_replica(self, name=None, **overrides):
+        """Admit a new replica (build()-made fleets only). Reuses the shared
+        model, registry and tracer; the new replica's step programs come
+        straight from the shared model caches — no recompile."""
+        from .scheduler import ContinuousGenerateBatchingPredictor
+
+        if self._model is None:
+            raise RuntimeError("add_replica needs a ReplicaFleet.build() "
+                               "fleet (it owns the shared model handle)")
+        with self._lock:
+            name = name if name is not None else f"r{self._next_id}"
+            self._next_id += 1
+        kw = dict(self._replica_kwargs)
+        kw.update(overrides)
+        pred = ContinuousGenerateBatchingPredictor(
+            self._model, registry=self.registry, tracer=self.tracer,
+            component=name, **kw)
+        with self._lock:
+            self._replicas.append(_Replica(name, pred))
+        return name
+
+    def drain_replica(self, name):
+        """Stop routing NEW requests to `name`; its queued work finishes."""
+        rep = self._by_name(name)
+        if rep.state == "ready":
+            rep.state = "draining"
+
+    def undrain_replica(self, name):
+        rep = self._by_name(name)
+        if rep.state == "draining":
+            rep.state = "ready"
+
+    def retire_replica(self, name, drain_timeout=5.0):
+        """Drain-then-close: routing stops immediately, queued + in-flight
+        requests get up to `drain_timeout` to finish, then the replica's
+        threads come down and it reads as dead in the state gauge."""
+        rep = self._by_name(name)
+        rep.state = "draining"
+        rep.predictor.drain()
+        deadline = time.monotonic() + float(drain_timeout)
+        while time.monotonic() < deadline and rep.predictor.pending():
+            time.sleep(0.01)
+        rep.predictor.close()
+        rep.state = "dead"
+
+    # --------------------------------------------------------------- routing
+    def _pick(self, exclude=()):
+        """Least-loaded ready replica, skipping draining/dead members, open
+        circuit breakers (state read only — allow() would eat the half-open
+        probe), and already-tried names."""
+        best, best_load = None, None
+        for rep in self._snapshot():
+            if rep.name in exclude or self._refresh(rep) != "ready":
+                continue
+            if rep.predictor.breaker.state == "open":
+                continue
+            load = rep.predictor.pending()
+            if best is None or load < best_load:
+                best, best_load = rep, load
+        return best
+
+    def _dispatched(self, rep, outcome, tr, t_start):
+        self._dispatch_total.labels(rep.name, outcome).inc()
+        tr.child("fleet_dispatch", t_start, tr.now_us(),
+                 replica=rep.name, outcome=outcome)
+
+    def _admit(self, tr):
+        t_adm = tr.now_us()
+        try:
+            if self._draining.is_set():
+                raise ServiceUnavailable("fleet is shutting down",
+                                         retry_after=None)
+            self.admission.admit(self.pending())
+            if self._pick() is None:
+                raise ServiceUnavailable("no ready replicas",
+                                         retry_after=0.5)
+        except Rejected as e:
+            self.metrics.inc("rejected_busy" if isinstance(e, ServerBusy)
+                             else "rejected_unavailable")
+            tr.child("admission", t_adm, tr.now_us(), error=repr(e))
+            tr.finish("rejected", status=e.status, error=repr(e))
+            raise
+        tr.child("admission", t_adm, tr.now_us())
+        self.metrics.inc("accepted")
+
+    def _terminal(self, outcome, t0, tr, **tags):
+        self.metrics.inc(outcome)
+        if outcome in ("completed", "timeouts"):
+            self.metrics.observe_latency(self._clock() - t0)
+        tr.finish({"completed": "result", "timeouts": "timeout",
+                   "failed": "error"}[outcome], **tags)
+
+    def _dispatch(self, call, deadline, tr, t0):
+        """Shared failover loop: try least-loaded replicas until one accepts.
+
+        `call(rep)` runs the replica-side request to ITS outcome — for
+        infer() that is the full round trip, for infer_stream() just the
+        synchronous admission half — so every exception type below has one
+        meaning: busy/unavailable = failover, permanent = replica death +
+        failover, timeout/value-error = the request's own terminal."""
+        tried = set()
+        last_busy = None
+        while True:
+            if deadline is not None and deadline.expired():
+                self._terminal("timeouts", t0, tr, where="fleet_dispatch")
+                raise DeadlineExceeded("request timed out during fleet "
+                                       "dispatch")
+            rep = self._pick(exclude=tried)
+            if rep is None:
+                err = last_busy if last_busy is not None else \
+                    ServiceUnavailable("no ready replicas", retry_after=0.5)
+                self._terminal("failed", t0, tr, error=repr(err))
+                raise err
+            t_d = tr.now_us()
+            try:
+                out = call(rep)
+            except DeadlineExceeded:
+                self._dispatched(rep, "timeout", tr, t_d)
+                self._terminal("timeouts", t0, tr, replica=rep.name)
+                raise
+            except ServiceUnavailable as e:
+                if e.permanent or rep.predictor._sup.dead():
+                    # replica-kill healing: mark dead, re-dispatch the work
+                    rep.state = "dead"
+                    self._dispatched(rep, "dead", tr, t_d)
+                    continue
+                self._dispatched(rep, "unavailable", tr, t_d)
+                tried.add(rep.name)
+                last_busy = e
+            except ServerBusy as e:
+                self._dispatched(rep, "busy", tr, t_d)
+                tried.add(rep.name)
+                last_busy = e
+            except ValueError as e:
+                # malformed/oversized: no sibling can serve it either
+                self._dispatched(rep, "invalid", tr, t_d)
+                self._terminal("failed", t0, tr, error=repr(e))
+                raise
+            except Exception as e:
+                self._dispatched(rep, "error", tr, t_d)
+                self._terminal("failed", t0, tr, error=repr(e))
+                raise
+            else:
+                self._dispatched(rep, "ok", tr, t_d)
+                return rep, out
+
+    # ---------------------------------------------------------------- client
+    def infer(self, ids, timeout=None, deadline=None, trace_id=None, **kw):
+        """Fleet twin of the continuous scheduler's infer(): ONE deadline is
+        minted up front and rides through every failover attempt — a request
+        that hops replicas does not get its clock reset."""
+        if deadline is None and timeout is not None:
+            deadline = Deadline.after(float(timeout), self._clock)
+        tr = RequestTrace(self.tracer, trace_id)
+        t0 = self._clock()
+        self._admit(tr)
+        rep, out = self._dispatch(
+            lambda rep: rep.predictor.infer(ids, deadline=deadline,
+                                            trace_id=tr.trace_id, **kw),
+            deadline, tr, t0)
+        self._terminal("completed", t0, tr, replica=rep.name)
+        return out
+
+    def infer_stream(self, ids, timeout=None, deadline=None, trace_id=None,
+                     **kw):
+        """Streaming dispatch. Failover happens ONLY at admission time (the
+        replica-side infer_stream raises busy/unavailable synchronously,
+        before any tokens flow); once a replica accepts, the stream is
+        pinned to it and mid-stream death raises from the iterator exactly
+        like a single-replica deployment."""
+        if deadline is None and timeout is not None:
+            deadline = Deadline.after(float(timeout), self._clock)
+        tr = RequestTrace(self.tracer, trace_id)
+        t0 = self._clock()
+        self._admit(tr)
+        rep, gen = self._dispatch(
+            lambda rep: rep.predictor.infer_stream(
+                ids, deadline=deadline, trace_id=tr.trace_id, **kw),
+            deadline, tr, t0)
+        return self._stream_relay(rep, gen, tr, t0)
+
+    def _stream_relay(self, rep, gen, tr, t0):
+        """Relay the replica's token iterator, landing the fleet-level
+        terminal (conservation: this request was already `accepted`)."""
+        try:
+            yield from gen
+        except DeadlineExceeded:
+            self._terminal("timeouts", t0, tr, replica=rep.name)
+            raise
+        except GeneratorExit:
+            # consumer walked away: replica side already counted its
+            # timeout-terminal through _stream_pump's cancel path
+            self._terminal("timeouts", t0, tr, replica=rep.name,
+                           where="stream_abandoned")
+            raise
+        except Exception as e:
+            self._terminal("failed", t0, tr, replica=rep.name,
+                           error=repr(e))
+            raise
+        else:
+            self._terminal("completed", t0, tr, replica=rep.name)
+
+    # ------------------------------------------------------------- lifecycle
+    def ready(self) -> bool:
+        """At least one replica can take a dispatch right now (/readyz)."""
+        return not self._draining.is_set() and self._pick() is not None
+
+    def pending(self) -> int:
+        """Aggregate queued + in-flight across live replicas."""
+        return sum(rep.predictor.pending() for rep in self._snapshot()
+                   if self._refresh(rep) != "dead")
+
+    def drain(self):
+        self._draining.set()
+        for rep in self._snapshot():
+            if rep.state == "ready":
+                rep.state = "draining"
+            rep.predictor.drain()
+
+    def close(self):
+        self._draining.set()
+        for rep in self._snapshot():
+            rep.predictor.close()
+            rep.state = "dead"

@@ -37,8 +37,14 @@ import time
 import jax
 import jax.numpy as jnp
 
+from ..analysis.lockwitness import make_lock
 from ..profiler.profiler import RecordEvent
 from ..tensor import Tensor
+
+# serializes COLD runner builds only (see _runner_for): fleet replicas share
+# one model, and a shared lock beats per-model lazy-lock creation, which
+# would itself race
+_TRACE_LOCK = make_lock("generation._TRACE_LOCK")
 
 
 class GenerationMixin:
@@ -147,6 +153,26 @@ class GenerationMixin:
         if cache is None:
             cache = self._generate_cache = {}
         return cache
+
+    def _runner_for(self, cache_key, make_run):
+        """Build-or-fetch a compiled runner; single-compile under concurrency.
+
+        A ReplicaFleet runs N scheduler tick threads over ONE shared model —
+        that sharing is what makes replica admit/retire/kill recompile-free —
+        so two replicas cold-starting the same (shape, pool-signature) key
+        must not trace it twice. Hit path stays lock-free (dict get is
+        atomic); only the cold build serializes. Returns (run, compiled_now).
+        """
+        cache = self._runner_cache()
+        run = cache.get(cache_key)
+        if run is not None:
+            return run, False
+        with _TRACE_LOCK:
+            run = cache.get(cache_key)
+            if run is not None:
+                return run, False
+            run = cache[cache_key] = make_run()
+            return run, True
 
     @staticmethod
     def _emit_timing(timing_hook, path, B, P, new_tokens, compiled, t0):
@@ -269,11 +295,7 @@ class GenerationMixin:
         # Sampler params are traced inputs, so they are NOT in the key.
         cache_key = (B, P, max_new_tokens, eos, str(ids.dtype),
                      str(decode_dtype), decode_kernel)
-        run_cache = self._runner_cache()
-        run = run_cache.get(cache_key)
-        compiled_now = run is None
-        if run is None:
-            run = run_cache[cache_key] = make_run()
+        run, compiled_now = self._runner_for(cache_key, make_run)
 
         was_training = self.training
         self.eval()
@@ -398,11 +420,7 @@ class GenerationMixin:
         cache_key = ("paged", B, P, max_new_tokens, NB, kv_cache.signature(),
                      greedy, float(temperature or 0.0), int(top_k or 0), eos,
                      str(ids.dtype), decode_kernel)
-        run_cache = self._runner_cache()
-        run = run_cache.get(cache_key)
-        compiled_now = run is None
-        if run is None:
-            run = run_cache[cache_key] = make_run()
+        run, compiled_now = self._runner_for(cache_key, make_run)
 
         was_training = self.training
         self.eval()
@@ -505,11 +523,7 @@ class GenerationMixin:
 
         cache_key = ("prefill_chunk", S, C, NB, kv_cache.signature(), eos,
                      str(ids_dtype), decode_kernel)
-        run_cache = self._runner_cache()
-        run = run_cache.get(cache_key)
-        compiled_now = run is None
-        if run is None:
-            run = run_cache[cache_key] = make_run()
+        run, compiled_now = self._runner_for(cache_key, make_run)
 
         was_training = self.training
         self.eval()
@@ -610,11 +624,7 @@ class GenerationMixin:
 
         cache_key = ("decode_step", S, T, NB, kv_cache.signature(), eos,
                      str(ids_dtype), decode_kernel)
-        run_cache = self._runner_cache()
-        run = run_cache.get(cache_key)
-        compiled_now = run is None
-        if run is None:
-            run = run_cache[cache_key] = make_run()
+        run, compiled_now = self._runner_for(cache_key, make_run)
 
         was_training = self.training
         self.eval()
@@ -777,11 +787,7 @@ class GenerationMixin:
 
         cache_key = ("verify_step", S, W, NB, kv_cache.signature(),
                      str(ids_dtype), decode_kernel)
-        run_cache = self._runner_cache()
-        run = run_cache.get(cache_key)
-        compiled_now = run is None
-        if run is None:
-            run = run_cache[cache_key] = make_run()
+        run, compiled_now = self._runner_for(cache_key, make_run)
 
         was_training = self.training
         self.eval()

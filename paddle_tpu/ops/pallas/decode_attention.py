@@ -263,6 +263,21 @@ def _paged_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
         _write_dead(o_ref.at[0], m_ref.at[0], l_ref.at[0])
 
 
+def _tp_shard_mesh(Hq, Hkv):
+    """The active jax mesh iff it carries a tp axis that head-shards this
+    shape: tp > 1 dividing Hkv (Hq follows — GQA groups are contiguous, so a
+    block-shard of Hq aligns with the local kv heads). None otherwise."""
+    from ...distributed.mesh import current_jax_mesh, mesh_axis_size
+
+    jm = current_jax_mesh()
+    if jm is None or "tp" not in jm.axis_names:
+        return None
+    tp = mesh_axis_size("tp", jm)
+    if tp <= 1 or Hkv % tp != 0 or Hq % Hkv != 0:
+        return None
+    return jm
+
+
 def paged_decode_attention(q, k_pages, v_pages, block_tables, lengths,
                            scale=None, kernel="pallas"):
     """Decode attention reading KV through per-request block tables.
@@ -275,7 +290,39 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, lengths,
     Pallas path: PrefetchScalarGridSpec prefetches the table so the k/v
     BlockSpec index_map picks page tbl[b, j] directly — the PagedAttention
     access pattern, no gather materialization.
+
+    Under a serving mesh with a tp axis (ISSUE-12), the whole call shard_maps
+    over the head axis: each chip runs the split-KV kernel on its LOCAL heads
+    against its LOCAL pool shard (attention is head-local, so no collective is
+    needed here — the only cross-chip exchange per launch is the sampled-logit
+    gather after the vocab-sharded lm_head).
     """
+    B, S, Hq, D = q.shape
+    Hkv = k_pages.shape[0]
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    jm = _tp_shard_mesh(Hq, Hkv)
+    if jm is not None:
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        inner = functools.partial(_paged_decode_attention_impl,
+                                  scale=float(scale), kernel=kernel)
+        fn = shard_map(
+            inner, mesh=jm,
+            in_specs=(P(None, None, "tp", None), P("tp"), P("tp"),
+                      P(None, None), P(None)),
+            out_specs=P(None, None, "tp", None),
+            check_rep=False)
+        return fn(q, k_pages, v_pages,
+                  jnp.asarray(block_tables, jnp.int32),
+                  _norm_lengths(lengths, B))
+    return _paged_decode_attention_impl(q, k_pages, v_pages, block_tables,
+                                        lengths, scale=scale, kernel=kernel)
+
+
+def _paged_decode_attention_impl(q, k_pages, v_pages, block_tables, lengths,
+                                 scale=None, kernel="pallas"):
     B, S, Hq, D = q.shape
     Hkv, P_, BS = k_pages.shape[0], k_pages.shape[1], k_pages.shape[2]
     NB = block_tables.shape[1]
@@ -356,6 +403,13 @@ def paged_cache_update(k_pages, v_pages, k_new, v_new, block_tables,
     v_vals = v_new.astype(v_pages.dtype).transpose(2, 0, 1, 3)
     k_pages = k_pages.at[:, page, slot].set(k_vals, mode="drop")
     v_pages = v_pages.at[:, page, slot].set(v_vals, mode="drop")
+    # keep the pool head-sharded over tp through the scatter so the step
+    # programs' committed outputs preserve the serving-mesh layout (no-op
+    # without a tp mesh — `constrain` drops absent/non-dividing axes)
+    from ...distributed.mesh import constrain
+
+    k_pages = constrain(k_pages, ["tp", None, None, None])
+    v_pages = constrain(v_pages, ["tp", None, None, None])
     return k_pages, v_pages
 
 

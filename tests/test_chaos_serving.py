@@ -801,3 +801,61 @@ def test_chaos_lock_witness_ends_clean_and_matches_static_graph(
     assert w.inversions == []
     # (c) observed ∪ static acquisition order is acyclic
     assert w.check_static(lock_order_graph()) == []
+
+
+def test_replica_kill_mid_storm_siblings_absorb_no_stranding(small_gpt):
+    """ISSUE-12 chaos leg: ThreadDeath into one fleet replica's batcher
+    mid-storm (restart budget 0 -> permanent death). The fleet observes the
+    permanent 503, marks the replica dead, and re-dispatches its backlog to
+    the sibling: every client still gets the right tokens (exactly-once
+    terminals at the FLEET boundary — accepted == completed, nothing
+    stranded, nothing double-completed) and the survivor's pool comes back
+    conserved. Runs under the chaos lock witness like every other leg."""
+    from paddle_tpu.inference.serving import ReplicaFleet
+
+    m, prompt, ref = small_gpt
+    f = FaultInjector()
+    fleet = ReplicaFleet.build(
+        m, n_replicas=2,
+        replica_kwargs=[dict(faults=f, max_restarts=0), {}],
+        max_slots=2, prefill_chunk=4, decode_steps=2, max_new_tokens=3,
+        decode_kernel="xla", block_size=8, num_blocks=16, max_seq_len=16)
+    try:
+        # warm both replicas, then arm the kill a few ticks out so r0 dies
+        # with requests in flight
+        np.testing.assert_array_equal(fleet.infer(prompt, timeout=120), ref)
+        f.install("batcher.tick", error=ThreadDeath("chaos-kill"), after=2)
+
+        N = 8
+        outs = [None] * N
+
+        def client(i):
+            try:
+                outs[i] = np.asarray(fleet.infer(prompt, timeout=300))
+            except Exception as e:  # noqa: BLE001 - storm bookkeeping
+                outs[i] = e
+
+        ts = [threading.Thread(target=client, args=(i,)) for i in range(N)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=300)
+        assert not any(t.is_alive() for t in ts)          # zero stranded
+        for o in outs:
+            assert isinstance(o, np.ndarray), o           # all re-dispatched
+            np.testing.assert_array_equal(o, ref)
+
+        states = fleet.replica_states()
+        assert states["r0"] == "dead" and states["r1"] == "ready", states
+
+        snap = dict(fleet.metrics.snapshot())
+        assert snap.get("accepted") == snap.get("completed") == N + 1
+        assert snap.get("failed", 0) == 0 and snap.get("timeouts", 0) == 0
+
+        # pool conservation on the SURVIVOR (the dead replica's pool is
+        # abandoned with its thread; the survivor must be clean)
+        surv = fleet._by_name("r1").predictor
+        assert surv.kv_cache.blocks_in_use == 0
+        surv.kv_cache.check_conservation()
+    finally:
+        fleet.close()
