@@ -25,6 +25,15 @@ runtime itself — lock-order cycles, unguarded shared writes, blocking calls
 under locks — plus the runtime lock witness (``analysis/lockwitness.py``)
 the chaos suite activates to check the observed acquisition order against
 the static graph. ``--self-check`` gates both.
+
+The third leg is the COMPILE-SURFACE lint (``analysis/compilesurface.py``,
+ISSUE-13): AST-extract the ``cache_key`` schema at every ``_runner_for``
+site in models/generation.py, derive the closed program inventory of a
+``ServingConfig``, and check it against a declared ``ProgramManifest``
+(rules: manifest-incomplete, unbounded-key, dead-bucket). The runtime twin
+is ``inference/warmup.py`` — AOT warmup of exactly that manifest gating
+/readyz, plus the post-ready recompile sentinel the chaos suite arms.
+``--self-check`` gates all three.
 """
 from .core import (  # noqa: F401
     Program,
@@ -48,6 +57,19 @@ from .lockwitness import (  # noqa: F401
     LockWitness,
     make_lock,
     make_rlock,
+)
+from .compilesurface import (  # noqa: F401
+    BUILTIN_SURFACE_ALLOWLIST,
+    SURFACE_RULES,
+    CompileSurfaceError,
+    ProgramManifest,
+    ServingConfig,
+    analyze_compile_surface,
+    default_manifest,
+    default_serving_configs,
+    extract_key_schemas,
+    surface_fixture_reports,
+    zoo_cross_check,
 )
 from .rules import RULES  # noqa: F401
 from .threads import (  # noqa: F401

@@ -12,12 +12,62 @@ tests; ``--json`` emits the same findings-by-rule structure the bench
 ``--threads [PATH]`` runs ONLY the thread lint — over PATH (a file or
 directory, every module treated as runtime: the seeded-violation fixture
 mode) or, with no PATH, over the installed ``paddle_tpu`` package.
+
+ISSUE-13 adds the compile-surface contract (analysis/compilesurface.py):
+the full self-check lints it via the ``compile_surface`` zoo entry;
+``--surface [PATH]`` runs ONLY that pass — strict fixture mode over PATH
+(a generation-like ``.py`` source, a ``{"configs","manifest"}`` ``.json``
+spec, or a directory of either) or the real tree when PATH is omitted;
+``--manifest [CONFIG]`` prints the DERIVED program inventory as JSON (the
+thing a deployment pastes into its declared manifest) for all shipped
+serving configs, one of them by name, or a ServingConfig ``.json`` file.
 """
 from __future__ import annotations
 
 import argparse
 import json
 import sys
+
+
+def _print_manifest(spec=None) -> int:
+    """``--manifest [CONFIG]``: resolve the config set, derive its closed
+    program inventory through the extracted key schemas, and print the
+    JSON a deployment declares (and AOTWarmup compiles)."""
+    import os
+
+    from .compilesurface import (CompileSurfaceError, ProgramManifest,
+                                 ServingConfig, default_serving_configs,
+                                 extract_key_schemas)
+
+    if spec is None:
+        configs = list(default_serving_configs())
+    elif os.path.isfile(spec):
+        with open(spec, "r") as fh:
+            obj = json.load(fh)
+        raw = obj if isinstance(obj, list) else obj.get("configs", [obj])
+        configs = [ServingConfig.from_json(c) for c in raw]
+    else:
+        configs = [c for c in default_serving_configs() if c.name == spec]
+        if not configs:
+            print(f"unknown serving config {spec!r}; shipped: "
+                  f"{[c.name for c in default_serving_configs()]} "
+                  "(or pass a ServingConfig .json file)", file=sys.stderr)
+            return 2
+    schemas = extract_key_schemas()
+    try:
+        per_config = {c.name: [list(k) for k in c.program_keys(schemas)]
+                      for c in configs}
+    except CompileSurfaceError as e:
+        print(f"key derivation failed: {e}", file=sys.stderr)
+        return 1
+    manifest = ProgramManifest.from_configs(configs, schemas=schemas,
+                                            name="derived")
+    print(json.dumps({
+        "configs": [c.to_json() for c in configs],
+        "programs": per_config,
+        "manifest": manifest.to_json(),
+    }, indent=2))
+    return 0
 
 
 def _thread_report(path=None):
@@ -52,6 +102,19 @@ def main(argv=None) -> int:
                              "directory, strict/runtime severities — the "
                              "seeded-fixture mode) or the installed "
                              "paddle_tpu package when PATH is omitted")
+    parser.add_argument("--surface", nargs="?", const="", default=None,
+                        metavar="PATH",
+                        help="run ONLY the compile-surface lint (ISSUE-13): "
+                             "strict fixture mode over PATH (a .py source, "
+                             "a configs+manifest .json spec, or a directory "
+                             "of either) or the real tree with the builtin "
+                             "allowlist when PATH is omitted")
+    parser.add_argument("--manifest", nargs="?", const="", default=None,
+                        metavar="CONFIG",
+                        help="print the derived step-program inventory as "
+                             "JSON and exit: for every shipped serving "
+                             "config (omitted), one of them by name, or a "
+                             "ServingConfig .json file")
     parser.add_argument("--json", action="store_true",
                         help="emit one JSON object instead of text")
     parser.add_argument("--list-rules", action="store_true",
@@ -62,15 +125,30 @@ def main(argv=None) -> int:
     from .threads import THREAD_RULES
 
     if args.list_rules:
+        from .compilesurface import SURFACE_RULES
+
         for rule_id, fn in RULES.items():
             doc = (fn.__doc__ or "").strip().split("\n")[0]
             print(f"{rule_id:18s} {doc}")
         for rule_id, doc in THREAD_RULES.items():
             print(f"{rule_id:18s} [threads] {doc}")
+        for rule_id, doc in SURFACE_RULES.items():
+            print(f"{rule_id:18s} [surface] {doc.split(chr(10))[0]}")
         return 0
 
+    if args.manifest is not None:
+        return _print_manifest(args.manifest or None)
+
     reports = []
-    if args.threads is not None:
+    if args.surface is not None:
+        from .compilesurface import (analyze_compile_surface,
+                                     surface_fixture_reports)
+
+        if args.surface:
+            reports.extend(surface_fixture_reports(args.surface))
+        else:
+            reports.append(analyze_compile_surface())
+    elif args.threads is not None:
         reports.append(_thread_report(args.threads or None))
     else:
         from .zoo import ZOO_PROGRAMS, zoo_reports

@@ -1,0 +1,653 @@
+"""Compile-surface lint: the deployment's program inventory as a contract.
+
+Every decode entry point in models/generation.py caches its compiled step
+program under a ``cache_key`` tuple built immediately before the
+``self._runner_for(cache_key, make_run)`` call. That construction IS the
+deployment's compile surface: for a fixed serving configuration the set of
+keys the runtime can ever request is finite and computable — unless a key
+component is fed by a raw per-request value, in which case the inventory is
+open and every novel value cold-compiles a whole program on live traffic
+(the recompile-hazard rule's deployment-level sibling).
+
+This pass makes that statically checkable:
+
+1. **Key-schema extraction** — parse generation.py, find each tuple
+   assigned to ``cache_key`` directly feeding a ``_runner_for`` call, and
+   classify every component's provenance: ``literal`` (the path tag),
+   ``shape`` (derived from an input array's shape/dtype — pinned by the
+   serving layer's launch geometry), ``config`` (fed by a server-pinned
+   parameter), ``bucketed`` (passed through a declared bounding function —
+   any call whose name carries "bucket"), or ``request`` (a raw
+   per-request scalar: the hazard).
+2. **Closed inventory derivation** — a ``ServingConfig`` (slots, chunk
+   width, decode steps, spec K, eos, pool signature, kernel) evaluates the
+   extracted schemas into the exact cache keys a continuous-scheduler
+   deployment can request; a ``ProgramManifest`` declares the keys the
+   deployment commits to pre-compiling (inference/warmup.py AOTWarmup
+   compiles exactly this manifest before /readyz reports ready).
+3. **Rules** (on the shared Finding/Allowlist machinery):
+
+   * ``manifest-incomplete`` (HIGH) — a runtime-constructible key is not
+     covered by the manifest: it cold-compiles after readiness. The
+     deploy gate.
+   * ``unbounded-key``       (HIGH) — a key component is fed by a raw
+     request-derived scalar; the inventory cannot be closed at all. Its
+     first real catch was the dense ``generate()`` path keying on raw
+     ``max_new_tokens`` (fixed by ``bucket_new_tokens``).
+   * ``dead-bucket``         (WARN; HIGH in strict/fixture mode) — a
+     manifest entry no analyzed config can request: warmup time and cache
+     space with no traffic behind it.
+
+The pass is pure AST + arithmetic — no jax import, no tracing — so it runs
+in milliseconds and belongs in CI: ``python -m paddle_tpu.analysis
+--self-check`` gates it (via the ``compile_surface`` zoo entry), ``--surface
+PATH`` runs the seeded-fixture mode, and ``--manifest`` prints the derived
+inventory as JSON. docs/ANALYSIS.md "Compile surface" has the full catalog.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import functools
+import json
+import math
+import os
+
+from .core import Report
+from .findings import HIGH, WARN, Allowlist, AllowlistEntry, Finding
+
+__all__ = [
+    "SURFACE_RULES", "BUILTIN_SURFACE_ALLOWLIST", "CompileSurfaceError",
+    "KeyComponent", "KeySchema", "ServingConfig", "ProgramManifest",
+    "extract_key_schemas", "default_serving_configs", "default_manifest",
+    "analyze_compile_surface", "surface_fixture_reports", "zoo_cross_check",
+]
+
+GENERATION_SOURCE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "models", "generation.py")
+
+SURFACE_RULES = {
+    "manifest-incomplete":
+        "a runtime-constructible step-program cache key is not covered by "
+        "the declared ProgramManifest — it cold-compiles on live traffic "
+        "after /readyz (the deploy gate)",
+    "unbounded-key":
+        "a cache-key component is fed by a raw request-derived scalar with "
+        "no declared bucket set — the program inventory is open and every "
+        "novel value compiles a new program",
+    "dead-bucket":
+        "a manifest entry no analyzed serving configuration can request — "
+        "warmup compiles it, nothing ever runs it",
+}
+
+# provenance kinds for key components
+LITERAL = "literal"      # constant in the tuple (the path tag)
+SHAPE = "shape"          # derived from an input array's .shape/.dtype
+CONFIG = "config"        # fed by a server-pinned parameter
+REQUEST = "request"      # fed by a raw per-request scalar (the hazard)
+BUCKETED = "bucketed"    # passed through a declared bounding function
+
+_RUNNER_CALL = "_runner_for"
+_BOUNDING_MARKER = "bucket"     # call names containing it bound a component
+
+# Which decode-entry parameters carry PER-REQUEST values at the API
+# boundary (vs being pinned by server config). The whole-batch entry
+# points (generate / generate_paged) are the public per-request decode
+# API — clients pass their own budget and sampler knobs — while the step
+# programs (prefill_chunk / decode_step / verify_step) only ever launch
+# from the continuous scheduler's tick loop with config-pinned widths
+# (inference/scheduler.py) and traced sampler inputs.
+REQUEST_SCALARS = {
+    "generate": ("max_new_tokens", "temperature", "top_k"),
+    "generate_paged": ("max_new_tokens", "temperature", "top_k"),
+}
+
+# key-tag -> the zoo programs that lint its compiled form (analysis/zoo.py).
+# zoo_cross_check() verifies this map against the live registry so a new
+# decode path cannot ship without graph-lint coverage, and a renamed zoo
+# entry cannot silently orphan a path.
+ZOO_FAMILIES = {
+    "dense": ("gpt_decode_dense",),
+    "paged": ("gpt_decode_paged",),
+    "prefill_chunk": ("gpt_prefill_chunk", "gpt_prefill_prefix",
+                      "gpt_prefill_chunk_tp"),
+    "decode_step": ("gpt_decode_step", "gpt_decode_step_tp"),
+    "verify_step": ("gpt_verify_step", "gpt_verify_step_tp"),
+}
+
+
+class CompileSurfaceError(RuntimeError):
+    """Schema extraction or key derivation cannot proceed (source drift)."""
+
+
+# ---------------------------------------------------------------- extraction
+@dataclasses.dataclass(frozen=True)
+class KeyComponent:
+    """One element of a cache_key tuple with its provenance."""
+    index: int
+    source: str          # ast.unparse of the component expression
+    kind: str            # LITERAL | SHAPE | CONFIG | REQUEST | BUCKETED
+    roots: tuple         # the parameter/attribute names it resolves to
+    line: int
+
+
+@dataclasses.dataclass(frozen=True)
+class KeySchema:
+    """The cache-key construction at one _runner_for call site."""
+    path: str            # key tag ("prefill_chunk", ...) or "dense"
+    method: str          # enclosing function name
+    line: int            # line of the cache_key tuple
+    components: tuple    # KeyComponent per tuple element
+
+    @property
+    def arity(self) -> int:
+        return len(self.components)
+
+    def request_components(self):
+        return [c for c in self.components if c.kind == REQUEST]
+
+
+def _ordered_stmts(body):
+    """Flatten a function body into statement order, descending into
+    compound statements (the cache_key assignments all live at the top
+    level today, but fixtures may nest them)."""
+    for stmt in body:
+        yield stmt
+        for attr in ("body", "orelse", "finalbody"):
+            yield from _ordered_stmts(getattr(stmt, attr, []) or [])
+        for handler in getattr(stmt, "handlers", []) or []:
+            yield from _ordered_stmts(handler.body)
+
+
+def _call_name(func):
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _roots(expr, env, params, _depth=0):
+    """Resolve an expression to its provenance roots: a set of
+    (kind, name) pairs where kind is 'param' | 'shape' | 'self' |
+    'bucket' | 'global'. Purely syntactic — simple assignments are
+    followed, everything else unions its children."""
+    if _depth > 24 or expr is None:
+        return set()
+    if isinstance(expr, ast.Constant):
+        return set()
+    if isinstance(expr, ast.Name):
+        if expr.id in env:
+            kind, payload = env[expr.id]
+            if kind == "shape":
+                return {("shape", payload)}
+            return _roots(payload, env, params, _depth + 1)
+        if expr.id in params:
+            return {("param", expr.id)}
+        return {("global", expr.id)}
+    if isinstance(expr, ast.Attribute):
+        if expr.attr in ("shape", "dtype"):
+            return {("shape", ast.unparse(expr.value))}
+        if isinstance(expr.value, ast.Name) and expr.value.id == "self":
+            return {("self", expr.attr)}
+        return _roots(expr.value, env, params, _depth + 1)
+    if isinstance(expr, ast.Call):
+        name = _call_name(expr.func)
+        if _BOUNDING_MARKER in name:
+            # a declared bounding transform closes the component's domain
+            # no matter what feeds it
+            return {("bucket", name)}
+        out = set()
+        if isinstance(expr.func, ast.Attribute):
+            out |= _roots(expr.func.value, env, params, _depth + 1)
+        for a in list(expr.args) + [kw.value for kw in expr.keywords]:
+            out |= _roots(a, env, params, _depth + 1)
+        return out
+    out = set()
+    for child in ast.iter_child_nodes(expr):
+        out |= _roots(child, env, params, _depth + 1)
+    return out
+
+
+def _component_kind(expr, roots, method):
+    if isinstance(expr, ast.Constant):
+        return LITERAL
+    if any(k == "bucket" for k, _ in roots):
+        return BUCKETED
+    request = set(REQUEST_SCALARS.get(method, ()))
+    if any(k == "param" and n in request for k, n in roots):
+        return REQUEST
+    if any(k == "shape" for k, _ in roots):
+        return SHAPE
+    return CONFIG
+
+
+def extract_key_schemas(source=None):
+    """Parse `source` (default: the installed models/generation.py) and
+    return {path: KeySchema} for every ``cache_key = (...)`` tuple that
+    feeds a ``_runner_for`` call. Raises CompileSurfaceError when a
+    _runner_for call's key cannot be traced to a tuple literal — that is
+    source drift the whole contract hangs on, not a findable."""
+    path = source or GENERATION_SOURCE
+    with open(path, "r") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    schemas = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        a = node.args
+        params = {p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)}
+        params.discard("self")
+        env = {}              # name -> ("expr", node) | ("shape", src)
+        tuples = {}           # name -> (Tuple node, lineno)
+        for stmt in _ordered_stmts(node.body):
+            if not isinstance(stmt, ast.Assign):
+                continue
+            tgt = stmt.targets[0] if len(stmt.targets) == 1 else None
+            if isinstance(tgt, ast.Name):
+                env[tgt.id] = ("expr", stmt.value)
+                if isinstance(stmt.value, ast.Tuple):
+                    tuples[tgt.id] = (stmt.value, stmt.lineno)
+            elif isinstance(tgt, ast.Tuple) and all(
+                    isinstance(e, ast.Name) for e in tgt.elts):
+                # `B, P = ids.shape` style unpack: every target is
+                # shape-derived when the RHS is a .shape access
+                rhs_roots = _roots(stmt.value, env, params)
+                is_shape = (isinstance(stmt.value, ast.Attribute)
+                            and stmt.value.attr == "shape") or all(
+                                k == "shape" for k, _ in rhs_roots)
+                for e in tgt.elts:
+                    if is_shape and rhs_roots:
+                        env[e.id] = ("shape", ast.unparse(stmt.value))
+                    else:
+                        env[e.id] = ("expr", stmt.value)
+            # the _runner_for site: Assign whose value calls _runner_for
+            if (isinstance(stmt.value, ast.Call)
+                    and _call_name(stmt.value.func) == _RUNNER_CALL
+                    and stmt.value.args):
+                key_arg = stmt.value.args[0]
+                if not isinstance(key_arg, ast.Name):
+                    raise CompileSurfaceError(
+                        f"{path}:{stmt.lineno}: {_RUNNER_CALL} key is not a "
+                        "name bound to a tuple literal")
+                if key_arg.id not in tuples:
+                    raise CompileSurfaceError(
+                        f"{path}:{stmt.lineno}: no tuple assignment to "
+                        f"{key_arg.id!r} precedes the {_RUNNER_CALL} call")
+                tup, line = tuples[key_arg.id]
+                comps = []
+                for i, el in enumerate(tup.elts):
+                    roots = _roots(el, env, params)
+                    comps.append(KeyComponent(
+                        index=i, source=ast.unparse(el),
+                        kind=_component_kind(el, roots, node.name),
+                        roots=tuple(sorted(f"{k}:{n}" for k, n in roots)),
+                        line=line))
+                tag = (tup.elts[0].value
+                       if tup.elts and isinstance(tup.elts[0], ast.Constant)
+                       and isinstance(tup.elts[0].value, str) else None)
+                name = tag or ("dense" if node.name == "generate"
+                               else node.name)
+                if name in schemas:
+                    name = f"{name}@{node.name}"
+                schemas[name] = KeySchema(path=name, method=node.name,
+                                          line=line, components=tuple(comps))
+    return schemas
+
+
+# ---------------------------------------------------------------- inventory
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """One deployment's serving shape — everything the continuous
+    scheduler pins about its step programs. ``kv_signature`` is
+    PagedKVCache.signature(): (num_layers, num_kv_heads, head_dim,
+    block_size, num_blocks, dtype)."""
+    name: str = "continuous"
+    slots: int = 8
+    prefill_chunk: int = 16
+    decode_steps: int = 4
+    spec_k: int = 0
+    eos_token_id: object = None
+    max_seq_len: object = None          # None: the whole pool, one sequence
+    kv_signature: tuple = (2, 4, 16, 128, 128, "bfloat16")
+    decode_kernel: object = "pallas"
+    ids_dtype: str = "int64"
+    paths: tuple = ("prefill_chunk", "decode_step")
+
+    @property
+    def block_size(self) -> int:
+        return int(self.kv_signature[3])
+
+    @property
+    def pool_tokens(self) -> int:
+        return int(self.kv_signature[3]) * int(self.kv_signature[4])
+
+    @property
+    def seq_capacity(self) -> int:
+        return int(self.max_seq_len) if self.max_seq_len else self.pool_tokens
+
+    @property
+    def table_width(self) -> int:
+        # PagedKVCache.blocks_for: max(1, ceil(seq / block_size))
+        return max(1, math.ceil(self.seq_capacity / self.block_size))
+
+    @property
+    def eos(self) -> int:
+        return -1 if self.eos_token_id is None else int(self.eos_token_id)
+
+    def active_paths(self):
+        paths = list(self.paths)
+        if self.spec_k > 0 and "verify_step" not in paths:
+            paths.append("verify_step")
+        return tuple(paths)
+
+    def program_keys(self, schemas=None):
+        """The closed set of cache keys this deployment can request.
+        Raises CompileSurfaceError on schema drift (arity/tag mismatch
+        between the builders below and the extracted source)."""
+        keys, errors = _derive(self, schemas or extract_key_schemas())
+        if errors:
+            raise CompileSurfaceError("; ".join(f.message for f in errors))
+        return keys
+
+    def to_json(self) -> dict:
+        out = dataclasses.asdict(self)
+        out["kv_signature"] = list(self.kv_signature)
+        out["paths"] = list(self.paths)
+        return out
+
+    @classmethod
+    def from_json(cls, obj) -> "ServingConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = sorted(set(obj) - known)
+        if unknown:
+            raise CompileSurfaceError(f"unknown ServingConfig fields "
+                                      f"{unknown}; known: {sorted(known)}")
+        kw = dict(obj)
+        if "kv_signature" in kw:
+            kw["kv_signature"] = tuple(kw["kv_signature"])
+        if "paths" in kw:
+            kw["paths"] = tuple(kw["paths"])
+        return cls(**kw)
+
+
+# per-path key builders; arity must match the extracted schema (drift gate)
+_KEY_BUILDERS = {
+    "prefill_chunk": (8, lambda c: (
+        "prefill_chunk", c.slots, c.prefill_chunk, c.table_width,
+        c.kv_signature, c.eos, c.ids_dtype, c.decode_kernel)),
+    "decode_step": (8, lambda c: (
+        "decode_step", c.slots, c.decode_steps, c.table_width,
+        c.kv_signature, c.eos, c.ids_dtype, c.decode_kernel)),
+    "verify_step": (7, lambda c: (
+        "verify_step", c.slots, c.spec_k + 1, c.table_width,
+        c.kv_signature, c.ids_dtype, c.decode_kernel)),
+}
+
+
+def _derive(config, schemas):
+    """(keys, findings) for one config: the concrete cache keys its active
+    paths request, plus manifest-incomplete findings for paths whose key
+    set cannot be closed (no builder, schema drift)."""
+    keys, findings = [], []
+    for path in config.active_paths():
+        schema = schemas.get(path)
+        if schema is None:
+            findings.append(Finding(
+                "manifest-incomplete", HIGH,
+                f"config {config.name!r} activates path {path!r} but no "
+                f"cache-key schema for it was extracted from the source",
+                subject=f"{config.name}:{path}",
+                remediation="fix the paths= list or the source under "
+                            "analysis"))
+            continue
+        builder = _KEY_BUILDERS.get(path)
+        if builder is None:
+            findings.append(Finding(
+                "manifest-incomplete", HIGH,
+                f"config {config.name!r} activates path {path!r} whose key "
+                "set has no closed-form builder: its shapes are "
+                "request-derived (whole-batch API) — keep it off "
+                "warmup-gated deployments or declare buckets for it",
+                subject=f"{config.name}:{path}",
+                remediation="serve through the continuous scheduler paths "
+                            "(prefill_chunk/decode_step/verify_step)"))
+            continue
+        arity, build = builder
+        if schema.arity != arity:
+            findings.append(Finding(
+                "manifest-incomplete", HIGH,
+                f"key-schema drift on {path!r}: source builds "
+                f"{schema.arity} components, the derivation expects "
+                f"{arity} — the derived inventory would be wrong",
+                where=f"{os.path.basename(GENERATION_SOURCE)}:{schema.line}",
+                subject=f"{config.name}:{path}",
+                remediation="update analysis/compilesurface.py "
+                            "_KEY_BUILDERS next to the cache_key change"))
+            continue
+        keys.append(build(config))
+    return tuple(keys), findings
+
+
+def _freeze(key):
+    if isinstance(key, (list, tuple)):
+        return tuple(_freeze(k) for k in key)
+    return key
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramManifest:
+    """The declared program inventory: the cache keys a deployment commits
+    to pre-compiling (AOTWarmup) and to never exceeding (this lint)."""
+    name: str = "manifest"
+    programs: tuple = ()
+
+    @classmethod
+    def from_configs(cls, configs, schemas=None,
+                     name="derived") -> "ProgramManifest":
+        schemas = schemas or extract_key_schemas()
+        seen, out = set(), []
+        for cfg in configs:
+            for key in _derive(cfg, schemas)[0]:
+                if key not in seen:
+                    seen.add(key)
+                    out.append(key)
+        return cls(name=name, programs=tuple(out))
+
+    def covers(self, key) -> bool:
+        return _freeze(key) in {_freeze(p) for p in self.programs}
+
+    def __contains__(self, key) -> bool:
+        return self.covers(key)
+
+    def to_json(self) -> dict:
+        return {"name": self.name,
+                "programs": [list(p) for p in self.programs]}
+
+    @classmethod
+    def from_json(cls, obj) -> "ProgramManifest":
+        return cls(name=obj.get("name", "manifest"),
+                   programs=tuple(_freeze(p) for p in obj["programs"]))
+
+
+@functools.lru_cache(maxsize=1)
+def default_serving_configs():
+    """The deployment shapes the shipped serving defaults produce, at the
+    zoo smoke pool geometry (analysis/zoo.py _continuous_smoke): the
+    continuous scheduler's default knobs over the 2-layer GPT smoke pool,
+    with and without speculative decoding. These are what --self-check
+    lints and what the default manifest covers."""
+    base = ServingConfig(name="continuous-default")
+    return (base,
+            dataclasses.replace(base, name="continuous-spec", spec_k=4))
+
+
+def default_manifest() -> ProgramManifest:
+    return ProgramManifest.from_configs(default_serving_configs(),
+                                        name="default-serving")
+
+
+# --------------------------------------------------------------- the rules
+# Findings the pass is right about but the code is right to keep: the
+# paged whole-batch path keys on its sampler scalars and budget, which ARE
+# per-request at the generate_paged API boundary — but the serving layer
+# never feeds them request values (GenerateBatchingPredictor._run_batch
+# pins max_new_tokens to the server cap and the fixed-batch path rejects
+# per-request sampler knobs: supports_sampler_knobs=False). Visible
+# suppressions, not a weakened rule.
+_PAGED_PIN = ("the fixed-batch serving path pins this scalar: _run_batch "
+              "passes the server-wide max_new_tokens cap and "
+              "supports_sampler_knobs=False rejects per-request sampler "
+              "headers (inference/serving.py), so one value per deployment "
+              "reaches generate_paged")
+BUILTIN_SURFACE_ALLOWLIST = Allowlist([
+    AllowlistEntry("unbounded-key", subject="paged:max_new_tokens",
+                   reason=_PAGED_PIN),
+    AllowlistEntry("unbounded-key", subject="paged:greedy",
+                   reason=_PAGED_PIN),
+    AllowlistEntry("unbounded-key", subject="paged:float(temperature or "
+                   "0.0)", reason=_PAGED_PIN),
+    AllowlistEntry("unbounded-key", subject="paged:int(top_k or 0)",
+                   reason=_PAGED_PIN),
+])
+
+
+def _key_subject(key) -> str:
+    head = key[:3] if isinstance(key[0], str) else ("dense",) + tuple(key[:2])
+    return ":".join(str(k) for k in head)
+
+
+def analyze_compile_surface(configs=None, manifest=None, *, source=None,
+                            allowlist=None, strict=False,
+                            name="compile-surface") -> Report:
+    """Run the compile-surface lint; returns the shared Report type.
+
+    configs: ServingConfigs to derive inventories for. Default: the
+        shipped default_serving_configs() — unless `source` points at a
+        fixture file, in which case default is no configs (pure AST mode).
+    manifest: the declared ProgramManifest. Default: derived from
+        `configs` via the shipped schemas — i.e. the default self-check
+        asserts the DEFAULT manifest is exactly closed over the default
+        configs; fixtures pass a deliberately wrong one.
+    strict: fixture/audit mode — dead-bucket escalates to HIGH so seeded
+        violations gate the CLI exit code.
+    """
+    schemas = extract_key_schemas(source)
+    rel = source or os.path.join("paddle_tpu", "models", "generation.py")
+    if configs is None:
+        configs = () if source is not None else default_serving_configs()
+
+    findings = []
+    for schema in schemas.values():
+        for comp in schema.request_components():
+            roots = [r.split(":", 1)[1] for r in comp.roots
+                     if r.startswith("param:")]
+            findings.append(Finding(
+                "unbounded-key", HIGH,
+                f"{schema.path} cache key [{comp.index}] `{comp.source}` is "
+                f"fed by per-request scalar(s) {roots or comp.source} with "
+                "no declared bucket set — every distinct value compiles a "
+                "new whole program",
+                where=f"{rel}:{comp.line}",
+                subject=f"{schema.path}:{comp.source}",
+                remediation="bucket the component to a declared set "
+                            "(models/generation.py bucket_new_tokens) or "
+                            "pin it at the serving layer"))
+
+    derived = {}        # key -> [config names]
+    for cfg in configs:
+        keys, errs = _derive(cfg, schemas)
+        findings.extend(errs)
+        for k in keys:
+            derived.setdefault(k, []).append(cfg.name)
+
+    if manifest is None:
+        manifest = ProgramManifest(name="derived", programs=tuple(derived))
+
+    for key, names in derived.items():
+        if not manifest.covers(key):
+            findings.append(Finding(
+                "manifest-incomplete", HIGH,
+                f"runtime-constructible key {key} (config(s) "
+                f"{', '.join(names)}) is not covered by manifest "
+                f"{manifest.name!r} — it cold-compiles on live traffic "
+                "after /readyz",
+                where=rel, subject=_key_subject(key),
+                remediation="add the program to the manifest (python -m "
+                            "paddle_tpu.analysis --manifest prints the "
+                            "derived inventory) or drop the config shape"))
+    for key in manifest.programs:
+        if _freeze(key) not in derived:
+            findings.append(Finding(
+                "dead-bucket", HIGH if strict else WARN,
+                f"manifest program {key} is not derivable from any "
+                "analyzed config — warmup compiles it, nothing requests it",
+                where=manifest.name, subject=_key_subject(key),
+                remediation="drop the stale bucket, or add the config "
+                            "that needs it to the analyzed set"))
+
+    al = allowlist if allowlist is not None else BUILTIN_SURFACE_ALLOWLIST
+    kept, suppressed = al.apply(findings, backend="")
+    return Report(name, kept, suppressed, tuple(SURFACE_RULES))
+
+
+# ------------------------------------------------------------ fixture mode
+def surface_fixture_reports(path):
+    """Seeded-violation mode for ``--surface PATH``: a ``.py`` file is a
+    generation-like source analyzed in pure AST mode; a ``.json`` file is
+    {"configs": [...], "manifest": {...}, "source"?: "rel.py"}; a
+    directory runs every such fixture inside it. Everything is strict."""
+    if os.path.isdir(path):
+        names = sorted(n for n in os.listdir(path)
+                       if n.endswith((".py", ".json")))
+        out = []
+        for n in names:
+            out.extend(surface_fixture_reports(os.path.join(path, n)))
+        return out
+    label = f"compile-surface[{os.path.basename(path)}]"
+    if path.endswith(".json"):
+        with open(path, "r") as fh:
+            spec = json.load(fh)
+        configs = tuple(ServingConfig.from_json(c)
+                        for c in spec.get("configs", []))
+        manifest = (ProgramManifest.from_json(spec["manifest"])
+                    if "manifest" in spec else None)
+        source = spec.get("source")
+        if source is not None and not os.path.isabs(source):
+            source = os.path.join(os.path.dirname(path), source)
+        return [analyze_compile_surface(
+            configs, manifest, source=source, strict=True,
+            allowlist=Allowlist([]), name=label)]
+    return [analyze_compile_surface(
+        (), None, source=path, strict=True, allowlist=Allowlist([]),
+        name=label)]
+
+
+# ------------------------------------------------------------- zoo contract
+def zoo_cross_check(schemas=None):
+    """Verify ZOO_FAMILIES against the live zoo registry: every extracted
+    key schema must have at least one registered zoo program linting its
+    compiled form, and every decode-side zoo program must be claimed by
+    exactly one family. Returns {path: (zoo programs,)}; raises
+    CompileSurfaceError on a gap (a new decode path without lint coverage
+    is a contract violation, not a finding)."""
+    from .zoo import ZOO_PROGRAMS     # lazy: zoo imports this module
+
+    schemas = schemas or extract_key_schemas()
+    registered = set(ZOO_PROGRAMS)
+    out = {}
+    for path in schemas:
+        family = ZOO_FAMILIES.get(path)
+        if not family:
+            raise CompileSurfaceError(
+                f"decode path {path!r} has no zoo lint family — register "
+                "its compiled program in analysis/zoo.py and map it in "
+                "ZOO_FAMILIES")
+        missing = [p for p in family if p not in registered]
+        if missing:
+            raise CompileSurfaceError(
+                f"ZOO_FAMILIES[{path!r}] names unregistered zoo "
+                f"program(s) {missing}")
+        out[path] = family
+    return out

@@ -33,6 +33,11 @@ One place builds the programs the CLI ``--self-check``, the bench
   runs inside a shard_map over tp. These entries declare the deployment
   axes, so the collective-axis rule is their deploy gate: a collective
   bound to any axis the serving mesh doesn't carry is a HIGH finding.
+* ``compile_surface`` — the ISSUE-13 program-inventory contract
+  (analysis/compilesurface.py) over the decode paths above: the derived
+  cache-key set of the shipped serving configs must be closed and covered
+  by the default manifest, and every key-site path must map to a zoo
+  family in this registry (zoo_cross_check).
 
 Smoke sizes on purpose: lint findings are properties of the GRAPH, not the
 weights, and the same rules fire on a 2-layer 64-wide GPT as on 350M — so
@@ -383,6 +388,24 @@ def gpt_verify_step_tp_report(thresholds=None, allowlist=None):
                                allowlist)
 
 
+def compile_surface_report(thresholds=None, allowlist=None):
+    """The compile-surface contract (ISSUE-13): not a traced program but
+    the inventory OVER the decode programs above — AST-extract every
+    ``_runner_for`` cache-key schema from models/generation.py, derive the
+    closed program set of the shipped serving configs, and lint it against
+    the default manifest (unbounded-key / manifest-incomplete /
+    dead-bucket). Also cross-checks ZOO_FAMILIES against this registry: a
+    new decode path without a zoo lint family fails the self-check HERE,
+    not silently. Graph-lint ``thresholds`` do not apply to host-side AST
+    analysis; the parameter exists for registry uniformity."""
+    del thresholds
+    from .compilesurface import analyze_compile_surface, zoo_cross_check
+
+    zoo_cross_check()
+    return analyze_compile_surface(allowlist=allowlist,
+                                   name="compile.surface")
+
+
 ZOO_PROGRAMS = {
     "gpt_train": gpt_train_report,
     "resnet_train": resnet_train_report,
@@ -395,6 +418,7 @@ ZOO_PROGRAMS = {
     "gpt_prefill_chunk_tp": gpt_prefill_chunk_tp_report,
     "gpt_decode_step_tp": gpt_decode_step_tp_report,
     "gpt_verify_step_tp": gpt_verify_step_tp_report,
+    "compile_surface": compile_surface_report,
 }
 
 

@@ -53,6 +53,7 @@ from __future__ import annotations
 import collections
 import itertools
 import queue
+import threading
 
 import numpy as np
 
@@ -62,6 +63,8 @@ from .kv_cache import CacheOutOfBlocks
 from .resilience import DeadlineExceeded, ServiceUnavailable
 from .serving import _PENDING, GenerateBatchingPredictor
 from .speculative import make_drafter
+from .warmup import AOTWarmup
+from .warmup import notify as _recompile_notify
 
 __all__ = ["ContinuousGenerateBatchingPredictor"]
 
@@ -159,6 +162,23 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
                          mixed-length pressure at the cost of bounded
                          long-prompt delay (they still admit whenever they
                          are the backlog minimum).
+    warmup               ISSUE-13: True compiles every step program of this
+                         configuration's compile-surface manifest
+                         (analysis/compilesurface.py) on a background
+                         "aot-warmup" thread before `ready()` reports True —
+                         /readyz stays 503 until the first request can run
+                         without a cold build. Once warmup covers the
+                         manifest, the post-ready compile SENTINEL arms: any
+                         later cold build increments
+                         `paddle_serving_recompiles_total{component,program}`
+                         and notifies the chaos-suite witness
+                         (inference/warmup.py). Default False: ready
+                         immediately, programs build lazily, sentinel off.
+    compile_cache_dir    optional persistent XLA compile-cache directory
+                         (warmup runs point the process at it); a restarted
+                         process reuses the serialized executables and pays
+                         trace time only — the docs/DEPLOYMENT.md cold-start
+                         runbook knob. Meaningful with warmup=True.
     """
 
     _component = "continuous"
@@ -168,7 +188,8 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
     def __init__(self, model, max_slots=8, prefill_chunk=16,
                  prefill_token_budget=None, decode_steps=4, max_seq_len=None,
                  eos_token_id=None, max_defers=32, spec_k=0, drafter="ngram",
-                 admit_policy="fifo", prefix_cache=False, **kwargs):
+                 admit_policy="fifo", prefix_cache=False, warmup=False,
+                 compile_cache_dir=None, **kwargs):
         self.max_slots = int(max_slots)
         self.prefill_chunk = int(prefill_chunk)
         self.prefill_token_budget = int(prefill_token_budget
@@ -206,6 +227,18 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
         # that races attachment just serves its admissions cold)
         self.prefix_cache = None
         self._prefix_hit_counter = None
+        # AOT warmup state exists BEFORE super().__init__ too: the tick
+        # loop's ready-gate preamble reads these from the batcher thread.
+        # Events/deques only (thread-lint atomic-type contract) — the warm
+        # thread writes, the batcher/readyz/test threads read.
+        self.warmup = bool(warmup)
+        self.compile_cache_dir = compile_cache_dir
+        self._warm_done = threading.Event()
+        self._warm_armed = threading.Event()
+        self._warm_stats: collections.deque = collections.deque(maxlen=8)
+        self._warm_errors: collections.deque = collections.deque(maxlen=8)
+        self._warm_thread = None
+        self._recompile_counter = None
         self._slots: list = [None] * self.max_slots
         # gauges scrape from other threads; witness-wrapped under chaos
         self._slot_lock = make_lock(
@@ -232,6 +265,56 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
                 "prefill compute", labels=("component",)).labels(
                     self._component)
             self.prefix_cache = pc      # published last: counter is ready
+        # ISSUE-13 post-ready compile sentinel: counter exists before the
+        # warm thread can arm it (the only reader of _recompile_counter is
+        # the armed branch of _gen_timing, and arming happens on this thread)
+        self._recompile_counter = self.metrics.registry.counter(
+            "paddle_serving_recompiles_total",
+            "Post-ready step-program cold builds by program — stays 0 when "
+            "the AOT warmup covered the compile-surface manifest "
+            "(analysis/compilesurface.py)", labels=("component", "program"))
+        if self.warmup and not self.fallback_dense:
+            self._warm_thread = threading.Thread(
+                target=self._warm_start, name="aot-warmup", daemon=True)
+            self._warm_thread.start()
+        else:
+            # nothing to compile ahead of time (or the dense fallback path
+            # owns its own cache): ready immediately, sentinel stays off
+            self._warm_done.set()
+
+    # ------------------------------------------------------------ AOT warmup
+    def _warm_start(self):
+        """Body of the aot-warmup thread: compile the manifest, then gate.
+
+        A warmup FAILURE never wedges readiness — the predictor serves cold
+        exactly as if warmup were off, with the error recorded in
+        warm_errors() and the sentinel left unarmed (a cold build after a
+        failed warmup is expected, not a violation)."""
+        try:
+            stats = AOTWarmup(self, cache_dir=self.compile_cache_dir,
+                              tracer=self.tracer).run()
+            self._warm_stats.append(stats)
+            if not stats["missing"] and not self._stop.is_set():
+                self._warm_armed.set()
+        except Exception as e:            # noqa: BLE001 — recorded, not fatal
+            self._warm_errors.append(e)
+        finally:
+            self._warm_done.set()
+
+    def warm_stats(self):
+        """Latest AOT warmup stats dict (programs/compiled/missing/
+        fingerprints/seconds), or None before the first run finishes."""
+        return self._warm_stats[-1] if self._warm_stats else None
+
+    def warm_errors(self):
+        return list(self._warm_errors)
+
+    def ready(self) -> bool:
+        """/readyz gate (ISSUE-13): False until the AOT warmup finished
+        (instantly true with warmup=False) and while shutting down. The
+        fleet router skips not-ready replicas (`ReplicaFleet._pick`), so a
+        warming replica joins rotation only once its programs are built."""
+        return self._warm_done.is_set() and not self._stop.is_set()
 
     # ------------------------------------------------------------- telemetry
     def _bind_scheduler_metrics(self):
@@ -288,9 +371,18 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
         """Launch-latency histogram only: the base hook also counts
         batch*new_tokens as generated, but a tick's width includes masked
         idle slots — actual tokens are counted per sequence at retirement
-        (_retire_ok) instead."""
+        (_retire_ok) instead.
+
+        Doubles as the post-ready compile sentinel's tap (ISSUE-13): once
+        the AOT warmup armed it, any launch that had to cold-build its step
+        program is a compile-surface violation — counted per program and
+        reported to the chaos-suite witness (inference/warmup.py)."""
         self._decode_hist.labels(self._component, info["path"]).observe(
             info["launch_s"])
+        if info["compiled"] and self._warm_armed.is_set():
+            self._recompile_counter.labels(
+                self._component, info["path"]).inc()
+            _recompile_notify(self._component, info["path"])
 
     def _phase_count(self, phase):
         with self._slot_lock:
@@ -444,6 +536,12 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
 
     # ------------------------------------------------------------- tick loop
     def _loop(self):
+        # ISSUE-13 ready gate: no tick runs (and so no step program can
+        # cold-build under traffic) until the aot-warmup thread finished.
+        # Wait with a poll so close() during warmup still exits promptly.
+        while self.warmup and not self._warm_done.wait(0.05):
+            if self._stop.is_set():
+                return
         if self.fallback_dense:
             # signature-mismatch degradation: the paged step programs would
             # scatter garbage; serve through the base collect-and-run loop

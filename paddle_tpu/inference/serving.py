@@ -1312,12 +1312,16 @@ class ReplicaFleet:
     def _pick(self, exclude=()):
         """Least-loaded ready replica, skipping draining/dead members, open
         circuit breakers (state read only — allow() would eat the half-open
-        probe), and already-tried names."""
+        probe), replicas still AOT-warming their step programs (ISSUE-13:
+        the predictor's own ready() gate), and already-tried names."""
         best, best_load = None, None
         for rep in self._snapshot():
             if rep.name in exclude or self._refresh(rep) != "ready":
                 continue
             if rep.predictor.breaker.state == "open":
+                continue
+            pred_ready = getattr(rep.predictor, "ready", None)
+            if pred_ready is not None and not pred_ready():
                 continue
             load = rep.predictor.pending()
             if best is None or load < best_load:

@@ -1,0 +1,34 @@
+"""Shared abstract-value fingerprinting for the recompile sentinels.
+
+Both sentinels answer the same question — "would this launch re-trace?" —
+by fingerprinting the launch arguments down to (treedef, shape, dtype):
+
+* training: ``TrainStep`` keys its AOT-hit check on it and the PR 4
+  ``StepMonitor`` sentinel fingerprints every ``__call__`` to count
+  ``paddle_train_recompiles_total`` (observability/training.py);
+* serving: the ISSUE-13 ``AOTWarmup`` (inference/warmup.py) fingerprints
+  the step-program launches it pre-compiles, so a post-ready cold build
+  can be reported against the exact avals the warmup covered.
+
+One helper, one definition: the two sentinels cannot drift on what counts
+as "the same shape".
+"""
+from __future__ import annotations
+
+import jax
+
+
+def aval_fingerprint(args, kwargs=None):
+    """(treedef, ((shape, dtype), ...)) over the flattened (args, kwargs).
+
+    Non-array leaves fingerprint as (None, type name) — value-insensitive
+    on purpose: jit traces plain Python scalars as weak-typed arrays, so a
+    changed int does NOT retrace and must not change the print. A changed
+    leaf TYPE, container structure, array shape, or dtype does.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs or {}))
+    return (
+        treedef,
+        tuple((getattr(x, "shape", None), str(getattr(x, "dtype", type(x))))
+              for x in leaves),
+    )

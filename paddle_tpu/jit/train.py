@@ -20,6 +20,7 @@ import numpy as np
 
 from ..autograd import tape
 from ..framework import random as _rng
+from .fingerprint import aval_fingerprint
 from ..nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
 from ..nn.layer import Layer
 from ..tensor import Tensor
@@ -535,14 +536,9 @@ class TrainStep:
         self._compiled_avals = self._arg_avals(args, kwargs)
         return self._compiled
 
-    @staticmethod
-    def _arg_avals(args, kwargs):
-        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
-        return (
-            treedef,
-            tuple((getattr(x, "shape", None), str(getattr(x, "dtype", type(x))))
-                  for x in leaves),
-        )
+    # one fingerprint definition shared with the serving warmup/sentinel
+    # (jit/fingerprint.py) so the two recompile sentinels cannot drift
+    _arg_avals = staticmethod(aval_fingerprint)
 
     def __call__(self, *args, **kwargs):
         mon = self._monitor

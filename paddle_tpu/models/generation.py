@@ -47,6 +47,23 @@ from ..tensor import Tensor
 _TRACE_LOCK = make_lock("generation._TRACE_LOCK")
 
 
+def bucket_new_tokens(max_new_tokens):
+    """The dense decode path's DECLARED max_new_tokens bucket set: the next
+    power of two. The cache key used to carry the raw per-request budget, so
+    mixed-budget fixed-batch traffic compiled one whole prefill+scan program
+    per distinct value — the compile-surface lint's `unbounded-key` rule
+    (analysis/compilesurface.py) exists because of exactly that. Keying on
+    the bucket bounds the inventory at log2(cap) programs per (B, P) shape;
+    generate() runs the bucket-width scan and truncates back to the request
+    (token-exact: sampling is a deterministic per-step key-split chain, so
+    the wider program's first n tokens equal the n-token program's output).
+    """
+    n = int(max_new_tokens)
+    if n <= 1:
+        return 1
+    return 1 << (n - 1).bit_length()
+
+
 class GenerationMixin:
     # ------------------------------------------------------------- state cast
     def _decode_state(self, dtype):
@@ -220,6 +237,14 @@ class GenerationMixin:
         logits and the sampled token (the registered `gpt_decode_dense`
         zoo program lints host-sync-clean with no allowlist entries).
 
+        The budget is BUCKETED in the cache key (bucket_new_tokens): the
+        compiled scan runs the next-power-of-two width and the result is
+        truncated to the requested count, so mixed-budget traffic shares
+        log2(cap) programs per shape instead of one per distinct value.
+        Token-exact: each step's sample depends only on the prefix and the
+        per-step key-split chain, so later (discarded) steps cannot affect
+        the first n tokens.
+
         `dtype`: decode compute dtype for weights + KV caches ('bfloat16'
         default — decode is weight-streaming-bound, see _decode_state; pass
         None to keep the parameters' own dtype).
@@ -236,7 +261,13 @@ class GenerationMixin:
         B, P = ids.shape
         self._decode_validate(P, max_new_tokens)
         num_layers, kv_h, hd = self._decode_cache_spec()
-        max_len = P + max_new_tokens
+        new_tokens = int(max_new_tokens)
+        # the COMPILED scan width is the declared bucket, not the raw
+        # per-request budget (compile-surface `unbounded-key`): mixed-budget
+        # traffic shares one program per (B, P) shape and the output is
+        # truncated back to the request below
+        new_bucket = bucket_new_tokens(new_tokens)
+        max_len = P + new_bucket
         decode_dtype = None if dtype is None else jnp.dtype(dtype)
         cache_dtype = decode_dtype or jnp.float32
         state = self._decode_state(decode_dtype)
@@ -276,10 +307,10 @@ class GenerationMixin:
                                                 stemps, stks)
                     return (nxt, caches, key, finished), nxt
 
-                if max_new_tokens > 1:
+                if new_bucket > 1:
                     (_, _, _, _), toks = jax.lax.scan(
                         body, (tok0, caches, key, finished),
-                        jnp.arange(max_new_tokens - 1))
+                        jnp.arange(new_bucket - 1))
                     toks = jnp.concatenate([tok0[None], toks], axis=0)
                 else:
                     toks = tok0[None]
@@ -293,8 +324,8 @@ class GenerationMixin:
         # jit caches on function identity: rebuilding the closure per call
         # would recompile prefill + the whole decode scan on every request.
         # Sampler params are traced inputs, so they are NOT in the key.
-        cache_key = (B, P, max_new_tokens, eos, str(ids.dtype),
-                     str(decode_dtype), decode_kernel)
+        cache_key = (B, P, bucket_new_tokens(max_new_tokens), eos,
+                     str(ids.dtype), str(decode_dtype), decode_kernel)
         run, compiled_now = self._runner_for(cache_key, make_run)
 
         was_training = self.training
@@ -303,9 +334,11 @@ class GenerationMixin:
             self._check_deadline(deadline, "dense decode launch")
             t0 = time.perf_counter()
             with RecordEvent("generate.dense"):
-                out = Tensor(run(state, ids, temps, tks,
-                                 jax.random.key(seed)))
-            self._emit_timing(timing_hook, "dense", B, P, max_new_tokens,
+                full = run(state, ids, temps, tks, jax.random.key(seed))
+                # truncate the bucket-width scan back to the request; the
+                # slice is a device view, one result fetch as before
+                out = Tensor(full[:, :P + new_tokens])
+            self._emit_timing(timing_hook, "dense", B, P, new_tokens,
                               compiled_now, t0)
             return out
         finally:
@@ -316,9 +349,11 @@ class GenerationMixin:
         """The cached compiled (state, prompt, temps, top_ks, key) -> ids
         program for a prior generate() shape, or None. Public so
         benches/audits can time the compiled program itself without
-        depending on the cache-key layout."""
+        depending on the cache-key layout. `max_new_tokens` resolves
+        through the declared bucket set (bucket_new_tokens), mirroring
+        what generate() keys on."""
         for k, run in (getattr(self, "_generate_cache", None) or {}).items():
-            if k[:3] == (batch, prompt_len, max_new_tokens):
+            if k[:3] == (batch, prompt_len, bucket_new_tokens(max_new_tokens)):
                 return run
         return None
 

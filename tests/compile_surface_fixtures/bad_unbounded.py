@@ -1,0 +1,24 @@
+"""Seeded `unbounded-key` violation: the pre-ISSUE-13 dense decode key.
+
+This is models/generation.py `generate()` as it keyed its compiled program
+BEFORE `bucket_new_tokens` landed: the raw per-request `max_new_tokens`
+flows into cache_key component [2], so every distinct client budget
+cold-compiles a whole prefill+scan program. Strict fixture mode
+(`python -m paddle_tpu.analysis --surface <this file>`) must flag exactly
+that component HIGH and exit 1 — proving the rule catches the precise
+defect the real tree fixed.
+
+Never imported; consumed as SOURCE by the AST pass.
+"""
+
+
+class _OldDenseModel:
+    def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
+                 top_k=0, eos_token_id=None, decode_kernel=None):
+        ids = input_ids
+        B, P = ids.shape
+        eos = -1 if eos_token_id is None else int(eos_token_id)
+        cache_key = (B, P, int(max_new_tokens), eos, str(ids.dtype),
+                     decode_kernel)
+        run = self._runner_for(cache_key, lambda: None)
+        return run(ids)

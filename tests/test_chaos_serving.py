@@ -859,3 +859,36 @@ def test_replica_kill_mid_storm_siblings_absorb_no_stranding(small_gpt):
         surv.kv_cache.check_conservation()
     finally:
         fleet.close()
+
+
+def test_warmed_scheduler_survives_thread_death_with_sentinel_armed(
+        small_gpt):
+    """ISSUE-13: the whole chaos suite runs with the post-ready compile
+    sentinel armed (conftest fixture), and this leg puts a WARMED-UP
+    scheduler through a batcher kill: the healed tick loop must serve the
+    re-enqueued sequence through the already-compiled step programs — a
+    single post-heal cold build would fail the test twice (the recompile
+    counter pin here and the sentinel fixture's teardown)."""
+    m, prompt, ref = small_gpt
+    f = FaultInjector()
+    gp = _continuous(m, faults=f, warmup=True)
+    try:
+        deadline = time.monotonic() + 90
+        while not gp.ready() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert gp.ready() and gp.warm_stats()["missing"] == []
+        np.testing.assert_array_equal(gp.infer(prompt, timeout=120), ref)
+
+        f.install("batcher.tick", error=ThreadDeath(), times=1)
+        deadline = time.monotonic() + 5
+        while gp._sup.alive() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        np.testing.assert_array_equal(gp.infer(prompt, timeout=120), ref)
+
+        for prog in ("prefill_chunk", "decode_step"):
+            assert gp._recompile_counter.labels(
+                gp._component, prog).value == 0, prog
+        assert gp.kv_cache.blocks_in_use == 0
+        gp.kv_cache.check_conservation()
+    finally:
+        gp.close()

@@ -1,0 +1,382 @@
+"""ISSUE-13: compile-surface lint + AOT warmup + post-ready sentinel.
+
+Three layers under test, matching the contract's shape:
+
+* static — cache-key schema extraction from models/generation.py, closed
+  inventory derivation, the three rules (seeded fixtures), CLI modes;
+* bucketing — the dense `generate()` max_new_tokens bucket (satellite 1):
+  nearby budgets share ONE compiled program, token-exact outputs;
+* runtime — AOTWarmup gating ready()/the fleet router, zero cold builds
+  on warmed traffic (including randomized configs), the recompile
+  sentinel counting forced violations, and warmup failure serving cold.
+"""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.analysis import compilesurface as cs
+from paddle_tpu.analysis.__main__ import main as cli_main
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "compile_surface_fixtures")
+
+
+# ------------------------------------------------------------ schema extraction
+@pytest.fixture(scope="module")
+def schemas():
+    return cs.extract_key_schemas()
+
+
+def test_extracts_all_five_runner_sites(schemas):
+    assert set(schemas) == {"dense", "paged", "prefill_chunk",
+                            "decode_step", "verify_step"}
+    assert schemas["dense"].method == "generate"
+    assert schemas["paged"].method == "generate_paged"
+
+
+def test_dense_budget_component_is_bucketed_not_request(schemas):
+    """The tentpole's first real catch, now fixed at the source: dense
+    component [2] goes through bucket_new_tokens, so its provenance is
+    BUCKETED — were the call ever dropped, this flips to REQUEST and the
+    unbounded-key rule (plus this pin) fails."""
+    comp = schemas["dense"].components[2]
+    assert comp.kind == cs.BUCKETED
+    assert "bucket_new_tokens" in comp.source
+    assert not schemas["dense"].request_components()
+
+
+def test_step_programs_have_no_request_components(schemas):
+    for path in ("prefill_chunk", "decode_step", "verify_step"):
+        assert not schemas[path].request_components(), path
+
+
+def test_paged_request_components_are_the_allowlisted_four(schemas):
+    comps = schemas["paged"].request_components()
+    assert [c.index for c in comps] == [3, 6, 7, 8]
+    assert {r for c in comps for r in c.roots} >= {
+        "param:max_new_tokens", "param:temperature", "param:top_k"}
+
+
+# ---------------------------------------------------------- inventory + rules
+def test_real_tree_is_clean_with_visible_paged_suppressions():
+    r = cs.analyze_compile_surface()
+    assert r.high() == [] and r.findings == []
+    sup = [(f, e) for f, e in r.suppressed if f.rule == "unbounded-key"]
+    assert len(sup) == 4
+    assert all("generate_paged" in e.reason for _, e in sup)
+
+
+def test_default_manifest_is_closed_over_default_configs(schemas):
+    manifest = cs.default_manifest()
+    # default + spec configs share prefill/decode keys; spec adds verify
+    assert len(manifest.programs) == 3
+    for cfg in cs.default_serving_configs():
+        for key in cfg.program_keys(schemas):
+            assert manifest.covers(key)
+
+
+def test_manifest_json_roundtrip_and_covers_freeze():
+    m = cs.default_manifest()
+    m2 = cs.ProgramManifest.from_json(
+        json.loads(json.dumps(m.to_json())))
+    for key in m.programs:
+        assert m2.covers(key)          # list-vs-tuple must not matter
+        assert list(key) in m2
+
+
+def test_serving_config_from_json_rejects_unknown_fields():
+    with pytest.raises(cs.CompileSurfaceError, match="unknown"):
+        cs.ServingConfig.from_json({"name": "x", "slotz": 8})
+
+
+@pytest.mark.parametrize("fixture,rule", [
+    ("bad_unbounded.py", "unbounded-key"),
+    ("bad_manifest_missing.json", "manifest-incomplete"),
+    ("bad_dead_bucket.json", "dead-bucket"),
+])
+def test_seeded_fixture_trips_exactly_its_rule(fixture, rule):
+    reports = cs.surface_fixture_reports(os.path.join(FIXTURES, fixture))
+    assert len(reports) == 1
+    highs = reports[0].high()
+    assert len(highs) == 1 and highs[0].rule == rule
+    assert cli_main(["--surface", os.path.join(FIXTURES, fixture)]) == 1
+
+
+def test_clean_step_source_fixture_reports_clean():
+    reports = cs.surface_fixture_reports(
+        os.path.join(FIXTURES, "_step_source.py"))
+    assert [r.high() for r in reports] == [[]]
+
+
+def test_cli_surface_real_tree_and_directory_modes(capsys):
+    assert cli_main(["--surface"]) == 0
+    assert "allowlisted" in capsys.readouterr().out
+    assert cli_main(["--surface", FIXTURES]) == 1
+
+
+def test_cli_manifest_prints_derived_inventory(capsys):
+    assert cli_main(["--manifest"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert [c["name"] for c in payload["configs"]] == [
+        "continuous-default", "continuous-spec"]
+    assert len(payload["manifest"]["programs"]) == 3
+    spec_paths = [k[0] for k in payload["programs"]["continuous-spec"]]
+    assert spec_paths == ["prefill_chunk", "decode_step", "verify_step"]
+    assert cli_main(["--manifest", "no-such-config"]) == 2
+    capsys.readouterr()
+
+
+def test_zoo_cross_check_and_registry_cover_every_path():
+    from paddle_tpu.analysis.zoo import ZOO_PROGRAMS
+
+    fam = cs.zoo_cross_check()
+    assert set(fam) == {"dense", "paged", "prefill_chunk", "decode_step",
+                        "verify_step"}
+    assert "compile_surface" in ZOO_PROGRAMS
+    assert len(ZOO_PROGRAMS) == 12
+
+
+def test_shared_aval_fingerprint_backs_both_sentinels():
+    """Satellite 2: one fingerprint definition — the training sentinel's
+    staticmethod IS jit/fingerprint.aval_fingerprint, so the serving
+    warmup and StepMonitor cannot drift on what 'the same shape' means."""
+    from paddle_tpu.jit.fingerprint import aval_fingerprint
+    from paddle_tpu.jit.train import TrainStep
+
+    assert TrainStep._arg_avals is aval_fingerprint
+    fp1 = aval_fingerprint((np.zeros((2, 3)),), {"k": 1})
+    # value-insensitive like jit itself (scalars trace as weak arrays)...
+    assert fp1 == aval_fingerprint((np.zeros((2, 3)),), {"k": 2})
+    # ...but shape, dtype, leaf type, and structure changes all retrace
+    assert fp1 != aval_fingerprint((np.zeros((2, 4)),), {"k": 1})
+    assert fp1 != aval_fingerprint((np.zeros((2, 3), np.float32),), {"k": 1})
+    assert fp1 != aval_fingerprint((np.zeros((2, 3)),), {"k": "1"})
+    assert fp1 != aval_fingerprint((np.zeros((2, 3)),), {"j": 1})
+
+
+# ------------------------------------------------------- dense bucketing (S1)
+def test_bucket_new_tokens_values():
+    from paddle_tpu.models.generation import bucket_new_tokens
+
+    assert [bucket_new_tokens(n) for n in (0, 1, 2, 3, 4, 5, 8, 9, 17)] == \
+        [1, 1, 2, 4, 4, 8, 8, 16, 32]
+
+
+@pytest.fixture(scope="module")
+def tiny_gpt():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    with paddle.utils.unique_name.guard():
+        paddle.seed(11)
+        m = GPTForCausalLM(GPTConfig(vocab_size=128, hidden_size=32,
+                                     num_layers=1, num_heads=2,
+                                     max_position=64, dropout=0.0))
+    m.eval()
+    return m
+
+
+def test_dense_budgets_share_bucket_program_token_exact(tiny_gpt):
+    m = tiny_gpt
+    prompt = np.arange(1, 9, dtype="int64")[None]
+    o3 = m.generate(paddle.to_tensor(prompt), max_new_tokens=3,
+                    dtype=None, decode_kernel="xla")
+    o4 = m.generate(paddle.to_tensor(prompt), max_new_tokens=4,
+                    dtype=None, decode_kernel="xla")
+    # token parity pin: budget 3 is EXACTLY budget 4 truncated
+    assert tuple(o3.shape) == (1, 11) and tuple(o4.shape) == (1, 12)
+    np.testing.assert_array_equal(np.asarray(o3._value),
+                                  np.asarray(o4._value)[:, :11])
+    # one compiled program serves both budgets (the declared bucket set)
+    assert m.compiled_generate_runner(1, 8, 3) is \
+        m.compiled_generate_runner(1, 8, 4)
+
+
+# ----------------------------------------------------------- runtime (warmup)
+def _continuous(m, **kw):
+    from paddle_tpu.inference.scheduler import (
+        ContinuousGenerateBatchingPredictor,
+    )
+
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("prefill_chunk", 4)
+    kw.setdefault("decode_steps", 2)
+    kw.setdefault("max_new_tokens", 3)
+    kw.setdefault("decode_kernel", "xla")
+    kw.setdefault("block_size", 8)
+    kw.setdefault("num_blocks", 16)
+    kw.setdefault("max_seq_len", 16)
+    return ContinuousGenerateBatchingPredictor(m, **kw)
+
+
+def _wait_ready(pred, timeout=90):
+    deadline = time.monotonic() + timeout
+    while not pred.ready() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert pred.ready()
+
+
+def _recompiles(pred, program):
+    return pred._recompile_counter.labels(pred._component, program).value
+
+
+def test_serving_config_of_maps_live_predictor(tiny_gpt):
+    from paddle_tpu.inference.warmup import serving_config_of
+
+    pred = _continuous(tiny_gpt, spec_k=2)
+    try:
+        cfg = serving_config_of(pred)
+        assert cfg.name == "continuous" and cfg.slots == 2
+        assert cfg.prefill_chunk == 4 and cfg.decode_steps == 2
+        assert cfg.spec_k == 2 and cfg.decode_kernel == "xla"
+        assert cfg.kv_signature == tuple(pred.kv_cache.signature())
+        assert cfg.table_width == pred.table_width
+        assert cfg.active_paths() == ("prefill_chunk", "decode_step",
+                                      "verify_step")
+    finally:
+        pred.close()
+
+
+def test_aot_warmup_gates_ready_and_zero_cold_builds_on_traffic(tiny_gpt):
+    """The runtime half end to end: /readyz stays false until every
+    manifest program is compiled; traffic after readiness triggers ZERO
+    recompiles (counter and shared runner cache both pinned)."""
+    m = tiny_gpt
+    pred = _continuous(m, warmup=True)
+    try:
+        assert not pred.ready()         # compile takes >> ctor-to-here
+        _wait_ready(pred)
+        st = pred.warm_stats()
+        assert st["programs"] == 2 and st["missing"] == []
+        assert set(st["fingerprints"]) == {"prefill_chunk", "decode_step"}
+        assert pred._warm_armed.is_set()
+        prompt = np.arange(2, 8, dtype="int64")
+        ref = m.generate(paddle.to_tensor(prompt[None]), max_new_tokens=3,
+                         dtype=None, decode_kernel="xla")
+        n0 = len(m._runner_cache())     # after the dense reference compile
+        out = pred.infer(prompt, timeout=120)
+        np.testing.assert_array_equal(out, np.asarray(ref._value)[0])
+        assert len(m._runner_cache()) == n0
+        assert _recompiles(pred, "prefill_chunk") == 0
+        assert _recompiles(pred, "decode_step") == 0
+    finally:
+        pred.close()
+
+
+def test_randomized_configs_manifest_coverage_means_zero_cold_builds(
+        tiny_gpt):
+    """Property (satellite 3): for seeded-random scheduler shapes, warmup
+    over the DERIVED manifest implies a replayed serving session performs
+    zero post-ready cold builds — coverage, not luck, is what closes the
+    surface."""
+    m = tiny_gpt
+    rng = np.random.default_rng(1302)
+    for _ in range(2):
+        kw = dict(max_slots=int(rng.integers(2, 4)),
+                  prefill_chunk=int(rng.choice([2, 4])),
+                  decode_steps=int(rng.integers(1, 3)),
+                  spec_k=int(rng.choice([0, 2])),
+                  eos_token_id=None)
+        pred = _continuous(m, warmup=True, **kw)
+        try:
+            _wait_ready(pred)
+            st = pred.warm_stats()
+            assert st["missing"] == [], (kw, st)
+            n0 = len(m._runner_cache())
+            for plen in rng.integers(1, 9, size=3):
+                pred.infer(rng.integers(0, 128, int(plen)).astype("int64"),
+                           timeout=120,
+                           max_new_tokens=int(rng.integers(1, 4)))
+            assert len(m._runner_cache()) == n0, kw
+            for prog in ("prefill_chunk", "decode_step", "verify_step"):
+                assert _recompiles(pred, prog) == 0, (kw, prog)
+        finally:
+            pred.close()
+
+
+def test_post_ready_sentinel_counts_forced_violation(tiny_gpt):
+    """Force the exact failure the contract forbids — a launch shape the
+    manifest never declared — and pin both halves of the alarm: the
+    counter and the active CompileSentinel witness."""
+    from paddle_tpu.inference import warmup as wu
+
+    m = tiny_gpt
+    pred = _continuous(m, warmup=True)
+    try:
+        _wait_ready(pred)
+        assert pred._warm_armed.is_set()
+        s = wu.activate(wu.CompileSentinel())
+        try:
+            S, W = pred.max_slots, pred.table_width
+            m.decode_step(np.zeros((S,), np.int64), np.zeros((S,), np.int64),
+                          np.zeros((S,), bool), pred.kv_cache,
+                          np.zeros((S, W), np.int32),
+                          steps=pred.decode_steps + 1, decode_kernel="xla",
+                          seed=0, eos_token_id=pred.eos_token_id,
+                          timing_hook=pred._gen_timing)
+        finally:
+            wu.deactivate()
+        assert list(s.violations) == [(pred._component, "decode_step")]
+        assert _recompiles(pred, "decode_step") == 1
+    finally:
+        pred.close()
+
+
+def test_warmup_failure_serves_cold_not_wedged(tiny_gpt, monkeypatch):
+    """A broken warmup must never wedge readiness: the predictor records
+    the error, reports ready, serves with lazy compiles, and the sentinel
+    stays UNARMED (cold builds after a failed warmup are expected)."""
+    from paddle_tpu.inference import scheduler as sched_mod
+
+    class _Boom:
+        def __init__(self, *a, **k):
+            pass
+
+        def run(self):
+            raise RuntimeError("injected warmup failure")
+
+    monkeypatch.setattr(sched_mod, "AOTWarmup", _Boom)
+    pred = _continuous(tiny_gpt, warmup=True)
+    try:
+        _wait_ready(pred)
+        assert pred.warm_stats() is None
+        assert len(pred.warm_errors()) == 1
+        assert not pred._warm_armed.is_set()
+        prompt = np.arange(3, 7, dtype="int64")
+        out = pred.infer(prompt, timeout=120)
+        assert len(out) == len(prompt) + 3
+        assert _recompiles(pred, "decode_step") == 0   # sentinel off
+    finally:
+        pred.close()
+
+
+def test_fleet_router_skips_warming_replicas_until_ready(tiny_gpt):
+    """ReplicaFleet._pick honors the predictor-level ready() gate: the
+    fleet reports not-ready while every replica is still warming, flips
+    ready once warmup lands, and serves with zero post-ready recompiles."""
+    from paddle_tpu.inference.serving import ReplicaFleet
+
+    m = tiny_gpt
+    fleet = ReplicaFleet.build(
+        m, n_replicas=2, warmup=True, max_slots=2, prefill_chunk=4,
+        decode_steps=2, max_new_tokens=3, decode_kernel="xla", block_size=8,
+        num_blocks=16, max_seq_len=16)
+    try:
+        deadline = time.monotonic() + 90
+        while not fleet.ready() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert fleet.ready()
+        prompt = np.arange(2, 8, dtype="int64")
+        out = fleet.infer(prompt, timeout=120)
+        ref = m.generate(paddle.to_tensor(prompt[None]), max_new_tokens=3,
+                         dtype=None, decode_kernel="xla")
+        np.testing.assert_array_equal(out, np.asarray(ref._value)[0])
+        for rep in fleet._snapshot():
+            pred = rep.predictor
+            assert pred.ready() and pred.warm_stats()["missing"] == []
+            for prog in ("prefill_chunk", "decode_step"):
+                assert _recompiles(pred, prog) == 0
+    finally:
+        fleet.close()

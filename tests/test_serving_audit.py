@@ -310,3 +310,62 @@ def test_mesh_bench_wires_fleet_churn_and_fields():
     assert "ThreadDeath(" in src
     assert "_generate_cache" in src
     assert "per_chip_pool_bytes(" in src
+
+
+def test_cold_start_fields_speedup_gate_and_audit():
+    out = {
+        "cold": {"ttft_from_start_s": 9.3, "post_ready_compiles": 0},
+        "warm": {"ttft_from_start_s": 3.5, "post_ready_compiles": 0},
+    }
+    bench.cold_start_fields(out)
+    assert out["warm_speedup"] == 2.66
+    assert out["post_ready_compiles"] == 0
+    assert out["audit"] == "ok"
+
+
+def test_cold_start_fields_flag_warm_slow_and_post_ready_compiles():
+    slow = {
+        "cold": {"ttft_from_start_s": 5.0, "post_ready_compiles": 0},
+        "warm": {"ttft_from_start_s": 4.0, "post_ready_compiles": 0},
+    }
+    bench.cold_start_fields(slow)
+    assert slow["warm_speedup"] == 1.25 and slow["audit"] == "warm-slow"
+
+    # a post-ready cold build outranks even a passing speedup: the manifest
+    # missed a program the traffic hit
+    leaky = {
+        "cold": {"ttft_from_start_s": 9.0, "post_ready_compiles": 1},
+        "warm": {"ttft_from_start_s": 3.0, "post_ready_compiles": 2},
+    }
+    bench.cold_start_fields(leaky)
+    assert leaky["warm_speedup"] == 3.0
+    assert leaky["post_ready_compiles"] == 3
+    assert leaky["audit"] == "post-ready-compiles-3"
+
+
+def test_cold_start_fields_skip_missing_sections():
+    out = {"cold": {"ttft_from_start_s": 9.3}}     # warm child crashed
+    bench.cold_start_fields(out)
+    assert "warm_speedup" not in out and "audit" not in out
+
+
+def test_cold_start_bench_wires_subprocess_children_and_fields():
+    """Source-level pin: bench_cold_start must run each leg in a FRESH
+    subprocess (in-process legs would share jax's live program cache and
+    measure nothing), reuse ONE persistent cache dir across both, and
+    route through cold_start_fields; the child must gate on ready() and
+    time TTFT from the parent's spawn instant (PADDLE_T0)."""
+    import inspect
+
+    src = inspect.getsource(bench.bench_cold_start)
+    assert "--cold-start-child" in src
+    assert "PADDLE_T0" in src
+    assert "cold_start_fields(" in src
+    assert 'for leg in ("cold", "warm")' in src
+
+    child = inspect.getsource(bench._cold_start_child_impl)
+    assert "warmup=True" in child
+    assert "compile_cache_dir=cache_dir" in child
+    assert "pred.ready()" in child
+    assert "infer_stream(" in child
+    assert "PADDLE_T0" in child

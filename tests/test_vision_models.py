@@ -59,23 +59,42 @@ def test_googlenet_aux_outputs():
     assert list(aux2.shape) == [1, 7]
 
 
-def test_param_counts_sane():
-    # reference param counts (torchvision-equivalent architectures), ~1% slack
-    expect = {
-        "alexnet": 61.1e6,
-        "vgg16": 138.4e6,
-        "mobilenet_v2": 3.50e6,
-        "squeezenet1_0": 1.25e6,
-        "densenet121": 7.98e6,
-        "shufflenet_v2_x1_0": 2.28e6,
-        "inception_v3": 23.8e6,
-        "resnext50_32x4d": 25.0e6,
-        "mobilenet_v3_large": 5.48e6,
-    }
-    for name, n in expect.items():
+# reference param counts (torchvision-equivalent architectures), ~1% slack.
+# Split by measured construction cost (ISSUE-13 budget rule): construction
+# wall tracks LAYER count, not params (the mobilenets/densenet/inception
+# take ~7-8s each; vgg16's 138M params only ~2s), so the shallow archs stay
+# the tier-1 canary (~10s) and the deep ones run in the slow-included
+# suite, paying for the warmup/cold-start legs this round added.
+_PARAM_COUNTS = {
+    "alexnet": 61.1e6,
+    "vgg16": 138.4e6,
+    "mobilenet_v2": 3.50e6,
+    "squeezenet1_0": 1.25e6,
+    "densenet121": 7.98e6,
+    "shufflenet_v2_x1_0": 2.28e6,
+    "inception_v3": 23.8e6,
+    "resnext50_32x4d": 25.0e6,
+    "mobilenet_v3_large": 5.48e6,
+}
+
+
+def _check_param_counts(names):
+    for name in names:
         model = getattr(models, name)()
         got = _n_params(model)
+        n = _PARAM_COUNTS[name]
         assert abs(got - n) / n < 0.02, f"{name}: {got} vs {n}"
+
+
+def test_param_counts_sane():
+    _check_param_counts(("alexnet", "vgg16", "squeezenet1_0",
+                         "shufflenet_v2_x1_0", "resnext50_32x4d"))
+
+
+@pytest.mark.slow
+def test_param_counts_sane_deep():
+    _check_param_counts(("mobilenet_v2", "densenet121",
+                         "inception_v3", "mobilenet_v3_large"))
 
 
 # train-step smoke: LeNet + shufflenet (BN-heavy) stay tier-1; the
