@@ -1287,6 +1287,66 @@ def thread_lint_fields(out):
     return out
 
 
+def bench_hbm_planning(on_accel, dev):
+    """HBM residency leg (ISSUE-14): build the smoke deployment plan —
+    params + paged pool + the static peak of both continuous step programs
+    (analysis/hbm.py), drift-checked against the compiled programs' real
+    memory_stats where this backend reports them — and run the four
+    residency rules. The gate is `high_total == 0` AND the plan components
+    summing to `planned_total_bytes`: a high finding means the shipped
+    serving defaults no longer fit their declared chip (or the estimator
+    went blind to the real numbers); a component-sum mismatch means the
+    plan arithmetic itself is broken. Same smoke geometry on or off
+    accelerator — residency is a property of shapes, not wall clock."""
+    import time as _time
+
+    from paddle_tpu.analysis.hbm import analyze_hbm_plan, smoke_plan
+
+    t0 = _time.perf_counter()
+    plan = smoke_plan()
+    report = analyze_hbm_plan(plan)
+    out = {
+        "budget_bytes": plan.budget_bytes,
+        "usable_bytes": plan.usable_bytes,
+        "components": plan.components(),
+        "planned_total_bytes": plan.planned_total_bytes,
+        "programs": {
+            p.name: {"static_peak_bytes": p.peak_bytes,
+                     "temp_bytes": p.temp_bytes,
+                     "measured_peak_bytes": p.measured_peak_bytes}
+            for p in plan.programs
+        },
+        "findings": [f.to_dict() for f in report.findings],
+        "suppressed_total": len(report.suppressed),
+        "table": plan.render_table(),
+        "plan_wall_sec": round(_time.perf_counter() - t0, 3),
+    }
+    hbm_planning_fields(out)
+    return out, None
+
+
+def hbm_planning_fields(out):
+    """Aggregate + audit fields for the hbm_planning section: findings-by-
+    rule, `high_total`, `components_sum_bytes`, and `audit` = ok iff zero
+    high findings AND the plan components sum to `planned_total_bytes`.
+    Pure function of the measured dict so tests can pin the wiring on
+    synthetic inputs (same contract as graph_lint_fields)."""
+    by_rule: dict = {}
+    high = 0
+    for f in out.get("findings", ()):
+        by_rule[f["rule"]] = by_rule.get(f["rule"], 0) + 1
+        if f.get("severity") == "high":
+            high += 1
+    out["findings_by_rule"] = by_rule
+    out["high_total"] = high
+    out["components_sum_bytes"] = sum(out.get("components", {}).values())
+    consistent = (out["components_sum_bytes"]
+                  == out.get("planned_total_bytes", -1))
+    out["audit"] = ("ok" if high == 0 and consistent
+                    else ("plan-inconsistent" if high == 0 else "lint-high"))
+    return out
+
+
 def _cold_start_child_impl(cache_dir):
     """Child body for the cold_start leg (ISSUE-13): ONE fresh process that
     builds a continuous predictor with `warmup=True` against a persistent
@@ -1710,6 +1770,15 @@ def main():
         tlint, tlint_err = bench_thread_lint(on_accel, dev)
     except Exception as e:
         tlint, tlint_err = None, {"error": repr(e)[:200]}
+    gc.collect()
+    try:
+        jax.clear_caches()
+    except Exception:
+        pass
+    try:
+        hbm_plan, hbm_plan_err = bench_hbm_planning(on_accel, dev)
+    except Exception as e:
+        hbm_plan, hbm_plan_err = None, {"error": repr(e)[:200]}
     try:
         cold_start, cold_start_err = bench_cold_start(on_accel, dev)
     except Exception as e:
@@ -1766,6 +1835,7 @@ def main():
             "checkpoint_overhead": ckpt if ckpt is not None else ckpt_err,
             "graph_lint": lint if lint is not None else lint_err,
             "thread_lint": tlint if tlint is not None else tlint_err,
+            "hbm_planning": hbm_plan if hbm_plan is not None else hbm_plan_err,
             "cold_start": (cold_start if cold_start is not None
                            else cold_start_err),
             "decode_attention": (decode_attn if decode_attn is not None

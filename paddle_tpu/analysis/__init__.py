@@ -33,7 +33,16 @@ site in models/generation.py, derive the closed program inventory of a
 (rules: manifest-incomplete, unbounded-key, dead-bucket). The runtime twin
 is ``inference/warmup.py`` — AOT warmup of exactly that manifest gating
 /readyz, plus the post-ready recompile sentinel the chaos suite arms.
-``--self-check`` gates all three.
+
+The fourth leg is the HBM RESIDENCY lint (``analysis/hbm.py``, ISSUE-14):
+a jaxpr-level liveness walk estimating each program's peak-memory
+watermark (drift-checked against the backend's real CompiledMemoryStats),
+composed into a per-chip ``DeploymentPlan`` — params/tp + KV pool + prefix
+tier + temps against a declared HBM budget (rules: hbm-over-budget,
+estimate-drift, oversized-temp, pool-misfit). The runtime twin is
+``plan_kv_pool`` — the continuous scheduler's ``hbm_budget=`` knob sizes
+its pool from the plan and publishes ``paddle_hbm_planned_bytes``.
+``--self-check`` gates all four.
 """
 from .core import (  # noqa: F401
     Program,
@@ -52,6 +61,21 @@ from .findings import (  # noqa: F401
     Allowlist,
     AllowlistEntry,
     Finding,
+    stale_allowlist_findings,
+)
+from .hbm import (  # noqa: F401
+    BUILTIN_HBM_ALLOWLIST,
+    HBM_RULES,
+    DeploymentPlan,
+    PeakEstimate,
+    ProgramEstimate,
+    analyze_hbm_plan,
+    analyze_hbm_residency,
+    estimate_memory_stats,
+    estimate_peak,
+    hbm_fixture_reports,
+    params_bytes_of,
+    plan_kv_pool,
 )
 from .lockwitness import (  # noqa: F401
     LockWitness,

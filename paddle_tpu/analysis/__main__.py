@@ -21,6 +21,14 @@ spec, or a directory of either) or the real tree when PATH is omitted;
 ``--manifest [CONFIG]`` prints the DERIVED program inventory as JSON (the
 thing a deployment pastes into its declared manifest) for all shipped
 serving configs, one of them by name, or a ServingConfig ``.json`` file.
+
+ISSUE-14 adds the HBM residency contract (analysis/hbm.py): the full
+self-check runs it via the ``hbm_residency`` zoo entry and appends the
+stale-allowlist audit (builtin suppressions that matched nothing);
+``--hbm [NAME|PATH]`` runs ONLY the residency pass — the smoke deployment
+plan's residency table plus the four rules (optionally for one shipped
+serving config by NAME), or strict fixture mode over a DeploymentPlan
+``.json`` / ``make_program()`` ``.py`` / directory PATH.
 """
 from __future__ import annotations
 
@@ -109,6 +117,14 @@ def main(argv=None) -> int:
                              "a configs+manifest .json spec, or a directory "
                              "of either) or the real tree with the builtin "
                              "allowlist when PATH is omitted")
+    parser.add_argument("--hbm", nargs="?", const="", default=None,
+                        metavar="NAME|PATH",
+                        help="run ONLY the HBM residency lint (ISSUE-14): "
+                             "the smoke deployment plan's residency table + "
+                             "rules (for one shipped serving config when "
+                             "NAME is given), or strict fixture mode over a "
+                             "DeploymentPlan .json / make_program() .py / "
+                             "directory PATH")
     parser.add_argument("--manifest", nargs="?", const="", default=None,
                         metavar="CONFIG",
                         help="print the derived step-program inventory as "
@@ -126,6 +142,7 @@ def main(argv=None) -> int:
 
     if args.list_rules:
         from .compilesurface import SURFACE_RULES
+        from .hbm import HBM_RULES
 
         for rule_id, fn in RULES.items():
             doc = (fn.__doc__ or "").strip().split("\n")[0]
@@ -134,13 +151,31 @@ def main(argv=None) -> int:
             print(f"{rule_id:18s} [threads] {doc}")
         for rule_id, doc in SURFACE_RULES.items():
             print(f"{rule_id:18s} [surface] {doc.split(chr(10))[0]}")
+        for rule_id, doc in HBM_RULES.items():
+            print(f"{rule_id:18s} [hbm] {doc.split(chr(10))[0]}")
         return 0
 
     if args.manifest is not None:
         return _print_manifest(args.manifest or None)
 
     reports = []
-    if args.surface is not None:
+    tables = []
+    if args.hbm is not None:
+        import os
+
+        from .hbm import (analyze_hbm_plan, hbm_fixture_reports, smoke_plan)
+
+        if args.hbm and os.path.exists(args.hbm):
+            reports.extend(hbm_fixture_reports(args.hbm))
+        else:
+            try:
+                plan = smoke_plan(config_name=args.hbm or None)
+            except ValueError as e:
+                print(str(e), file=sys.stderr)
+                return 2
+            tables.append(plan.render_table())
+            reports.append(analyze_hbm_plan(plan))
+    elif args.surface is not None:
         from .compilesurface import (analyze_compile_surface,
                                      surface_fixture_reports)
 
@@ -165,6 +200,23 @@ def main(argv=None) -> int:
         reports.extend(zoo_reports(include=include))
         if include is None:     # full self-check covers the host runtime too
             reports.append(_thread_report())
+            # ... and audits the suppressions themselves: a builtin entry
+            # that matched nothing across the whole run is a stale WARN
+            from .core import Report
+            from .compilesurface import BUILTIN_SURFACE_ALLOWLIST
+            from .findings import (BUILTIN_ALLOWLIST,
+                                   stale_allowlist_findings)
+            from .hbm import BUILTIN_HBM_ALLOWLIST
+            from .threads import BUILTIN_THREAD_ALLOWLIST
+
+            stale = stale_allowlist_findings([
+                ("graph", BUILTIN_ALLOWLIST),
+                ("thread", BUILTIN_THREAD_ALLOWLIST),
+                ("surface", BUILTIN_SURFACE_ALLOWLIST),
+                ("hbm", BUILTIN_HBM_ALLOWLIST),
+            ])
+            reports.append(Report("allowlist.audit", stale, [],
+                                  ("allowlist-stale",)))
 
     high_total = sum(len(r.high()) for r in reports)
     if args.json:
@@ -174,6 +226,8 @@ def main(argv=None) -> int:
             "status": "ok" if high_total == 0 else "lint-high",
         }))
     else:
+        for t in tables:
+            print(t)
         for r in reports:
             print(r.render())
         print(f"-- {len(reports)} program(s), {high_total} high-severity "

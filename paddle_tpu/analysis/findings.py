@@ -26,7 +26,8 @@ from __future__ import annotations
 import fnmatch
 
 __all__ = ["HIGH", "WARN", "INFO", "SEVERITIES", "Finding",
-           "AllowlistEntry", "Allowlist", "BUILTIN_ALLOWLIST"]
+           "AllowlistEntry", "Allowlist", "BUILTIN_ALLOWLIST",
+           "stale_allowlist_findings"]
 
 HIGH = "high"
 WARN = "warn"
@@ -73,9 +74,12 @@ class AllowlistEntry:
     ``contains`` (optional) must appear in the finding's message or
     provenance; ``backends`` (optional) restricts the entry to specific jax
     default backends. ``reason`` is mandatory — an allowlist entry without a
-    recorded why is just a weakened rule."""
+    recorded why is just a weakened rule. ``used`` records whether the entry
+    suppressed anything since process start (the stale-suppression audit's
+    input: a builtin entry that matched nothing across a full self-check has
+    outlived its rule, or its subject glob drifted off the program names)."""
 
-    __slots__ = ("rule", "subject", "contains", "reason", "backends")
+    __slots__ = ("rule", "subject", "contains", "reason", "backends", "used")
 
     def __init__(self, rule, subject="*", contains=None, *, reason,
                  backends=None):
@@ -87,6 +91,7 @@ class AllowlistEntry:
         self.contains = contains
         self.reason = reason
         self.backends = tuple(backends) if backends else None
+        self.used = False
 
     def matches(self, finding: Finding, backend: str) -> bool:
         if self.rule != finding.rule:
@@ -126,8 +131,39 @@ class Allowlist:
             if entry is None:
                 kept.append(f)
             else:
+                entry.used = True
                 suppressed.append((f, entry))
         return kept, suppressed
+
+
+def stale_allowlist_findings(named_lists) -> list:
+    """WARN ``allowlist-stale`` findings for entries that suppressed nothing.
+
+    ``named_lists``: (label, Allowlist) pairs — the builtin graph / thread /
+    surface / hbm lists in the self-check. Call AFTER every report has run;
+    ``used`` accumulates across Allowlist.apply calls, so an entry counts as
+    live if ANY program tripped it. First-match-wins means a shadowed
+    duplicate also reads stale — that is a finding too (delete the shadow).
+    A dead suppression is a rule silently weakened for nobody's benefit:
+    either its hazard was fixed (delete the entry) or the subject glob no
+    longer matches the program names (fix the glob before the hazard
+    returns unsuppressed)."""
+    out = []
+    for label, allowlist in named_lists:
+        for e in allowlist:
+            if e.used:
+                continue
+            scope = f" [backends={','.join(e.backends)}]" if e.backends else ""
+            out.append(Finding(
+                "allowlist-stale", WARN,
+                f"builtin {label} allowlist entry matched nothing this "
+                f"self-check: rule={e.rule} subject={e.subject!r}"
+                f"{scope} (reason on file: {e.reason})",
+                subject=f"allowlist:{label}",
+                remediation="delete the entry if its hazard was fixed, or "
+                            "re-aim the subject glob at the current program "
+                            "names"))
+    return out
 
 
 # Intentional, justified exceptions shipped with the repo. Keep this list

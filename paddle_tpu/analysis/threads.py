@@ -792,12 +792,10 @@ BUILTIN_THREAD_ALLOWLIST = Allowlist([
         reason="heal() sleeps its restart backoff under the supervisor lock "
                "BY DESIGN: the lock serializes concurrent healers so exactly "
                "one client pays the backoff and restarts the worker"),
-    AllowlistEntry(
-        "blocking-under-lock", subject="thread-lint",
-        contains="FaultInjector.check",
-        reason="injected delay faults sleep at the instrumented site on "
-               "purpose — simulating a slow call UNDER the caller's lock is "
-               "exactly the chaos the suite is probing"),
+    # (a FaultInjector.check blocking-under-lock entry lived here until the
+    # ISSUE-14 stale-suppression audit flagged it: the instrumented sleep
+    # site it excused no longer lints as blocking, so the entry was dead
+    # weight — exactly the rot allowlist-stale exists to catch)
     AllowlistEntry(
         "blocking-under-lock", subject="thread-lint", contains="TCPStore",
         reason="the store lock serializes the single-socket request/response "

@@ -38,6 +38,13 @@ One place builds the programs the CLI ``--self-check``, the bench
   cache-key set of the shipped serving configs must be closed and covered
   by the default manifest, and every key-site path must map to a zoo
   family in this registry (zoo_cross_check).
+* ``hbm_residency`` — the ISSUE-14 memory contract (analysis/hbm.py): the
+  default continuous ServingConfig's per-chip residency (params + smoke
+  KV pool + static temp peaks of its step programs) against the smoke HBM
+  budget, with the static estimator drift-checked against the backend's
+  real ``CompiledMemoryStats`` wherever those exist. A HIGH here means
+  the shipped defaults no longer fit their declared chip — or the
+  estimator went blind.
 
 Smoke sizes on purpose: lint findings are properties of the GRAPH, not the
 weights, and the same rules fire on a 2-layer 64-wide GPT as on 350M — so
@@ -406,6 +413,19 @@ def compile_surface_report(thresholds=None, allowlist=None):
                                    name="compile.surface")
 
 
+def hbm_residency_report(thresholds=None, allowlist=None):
+    """The HBM residency contract (ISSUE-14): statically estimate the peak
+    memory of the default continuous config's step programs, compose the
+    per-chip plan (params + pool + temps) against the smoke budget, and run
+    the four residency rules — drift-gated against real compiled
+    memory_stats where the backend provides them. Graph-lint ``thresholds``
+    do not apply; the parameter exists for registry uniformity."""
+    del thresholds
+    from .hbm import analyze_hbm_residency
+
+    return analyze_hbm_residency(allowlist=allowlist, name="hbm.residency")
+
+
 ZOO_PROGRAMS = {
     "gpt_train": gpt_train_report,
     "resnet_train": resnet_train_report,
@@ -419,6 +439,7 @@ ZOO_PROGRAMS = {
     "gpt_decode_step_tp": gpt_decode_step_tp_report,
     "gpt_verify_step_tp": gpt_verify_step_tp_report,
     "compile_surface": compile_surface_report,
+    "hbm_residency": hbm_residency_report,
 }
 
 

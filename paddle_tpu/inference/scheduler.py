@@ -179,6 +179,17 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
                          process reuses the serialized executables and pays
                          trace time only — the docs/DEPLOYMENT.md cold-start
                          runbook knob. Meaningful with warmup=True.
+    hbm_budget           ISSUE-14: per-chip HBM budget in bytes. When set
+                         (and no explicit kv_cache/num_blocks), the pool is
+                         sized FROM the residency plan — analysis/hbm.py
+                         ``plan_kv_pool`` takes what fits the budget after
+                         params + headroom, clamped to what max_slots x
+                         max_seq_len requests can actually reach — and the
+                         plan publishes ``paddle_hbm_planned_bytes{
+                         component=params|kv_pool|prefix_tier|temps}`` next
+                         to ``paddle_hbm_budget_bytes``. ValueError when the
+                         budget cannot fit even one sequence's blocks.
+                         Default None: num_blocks is taken as given.
     """
 
     _component = "continuous"
@@ -189,7 +200,7 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
                  prefill_token_budget=None, decode_steps=4, max_seq_len=None,
                  eos_token_id=None, max_defers=32, spec_k=0, drafter="ngram",
                  admit_policy="fifo", prefix_cache=False, warmup=False,
-                 compile_cache_dir=None, **kwargs):
+                 compile_cache_dir=None, hbm_budget=None, **kwargs):
         self.max_slots = int(max_slots)
         self.prefill_chunk = int(prefill_chunk)
         self.prefill_token_budget = int(prefill_token_budget
@@ -245,6 +256,26 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
             "scheduler.ContinuousGenerateBatchingPredictor._slot_lock")
         self.max_seq_len = None             # finalized below (needs kv_cache)
         self.table_width = None
+        # ISSUE-14: hbm_budget= sizes the pool FROM the residency plan
+        # (analysis/hbm.py plan_kv_pool) instead of taking num_blocks on
+        # faith — the static lint and the runtime share one arithmetic.
+        self.hbm_budget = None if hbm_budget is None else int(hbm_budget)
+        self._hbm_plan = None
+        if (self.hbm_budget is not None and kwargs.get("kv_cache") is None
+                and "num_blocks" not in kwargs):
+            from ..analysis.hbm import params_bytes_of, plan_kv_pool
+
+            layers, kv_h, hd = (int(x) for x in model._decode_cache_spec())
+            sizing = plan_kv_pool(
+                self.hbm_budget, num_layers=layers, num_kv_heads=kv_h,
+                head_dim=hd, block_size=kwargs.get("block_size", 32),
+                slots=self.max_slots, max_seq_len=max_seq_len,
+                params_bytes=params_bytes_of(model),
+                name=self._component, prefill_chunk=self.prefill_chunk,
+                decode_steps=self.decode_steps, spec_k=self.spec_k,
+                eos_token_id=self.eos_token_id)
+            kwargs["num_blocks"] = sizing["num_blocks"]
+            self._hbm_plan = sizing["plan"]
         super().__init__(model, max_batch_size=max_slots,
                          max_defers=max_defers, **kwargs)
         pool_tokens = self.kv_cache.num_blocks * self.kv_cache.block_size
@@ -350,6 +381,24 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
         # THE dial that says whether spec_k is paying for its verify width.
         # Returned (not self-assigned) so the _spec_counter attribute write
         # happens in __init__, before any worker thread can observe it.
+        # ISSUE-14 residency gauges: the plan the hbm_budget= knob sized the
+        # pool from, component-by-component, next to the declared budget —
+        # a scrape shows plan vs actual (paddle_kv_pool_per_chip_bytes is
+        # the pool's own ground truth to reconcile against). Absent when the
+        # knob is off: a gauge that would always read 0 is noise.
+        if self._hbm_plan is not None:
+            reg.gauge(
+                "paddle_hbm_budget_bytes",
+                "Declared per-chip HBM budget the serving plan was sized "
+                "against (scheduler hbm_budget= knob)",
+                labels=("component",)).labels(self._component).set(
+                    self.hbm_budget)
+            planned = reg.gauge(
+                "paddle_hbm_planned_bytes",
+                "Planned per-chip HBM residency by plan component "
+                "(analysis/hbm.py DeploymentPlan)", labels=("component",))
+            for part, nbytes in self._hbm_plan.components().items():
+                planned.labels(part).set(nbytes)
         spec_counter = reg.counter(
             "paddle_spec_tokens_total",
             "Speculative decoding tokens by kind: drafted (submitted to "

@@ -14,7 +14,8 @@ surfaces across jax versions and backends:
   ``CompiledMemoryStats``) flattened to plain ints: argument / output / temp /
   generated-code / alias bytes plus a derived ``peak_bytes`` watermark
   (arguments + outputs + temps + generated code − aliased), the HBM number a
-  creeping-toward-OOM alert wants.
+  creeping-toward-OOM alert wants.  Backends with no CompiledMemoryStats fall
+  back to the static estimator (analysis/hbm.py), tagged ``estimated=True``.
 * ``device_peak_flops`` — per-chip dense bf16 peak (public TPU specs), the
   denominator of MFU.  ``None`` off-accelerator so MFU degrades to "absent",
   never to a made-up number.
@@ -81,20 +82,28 @@ _MEM_FIELDS = {
 }
 
 
-def memory_stats(compiled) -> dict:
+def memory_stats(compiled, jaxpr=None) -> dict:
     """`compiled.memory_analysis()` flattened to ints.
 
     Keys: ``argument_bytes``, ``output_bytes``, ``temp_bytes``,
     ``generated_code_bytes``, ``alias_bytes`` and the derived watermark
     ``peak_bytes`` = argument + output + temp + generated_code − alias
-    (aliased donated buffers are counted once). ``{}`` when the backend has
-    no memory analysis."""
+    (aliased donated buffers are counted once).
+
+    Backends with no ``CompiledMemoryStats`` fall back to the static
+    estimator (analysis/hbm.py) instead of returning ``{}``: the full
+    liveness walk when the caller passes the program's ``jaxpr``, else a
+    degraded tier from the executable's aval/donation metadata alone.
+    Fallback dicts carry ``estimated=True`` so dashboards can tell a real
+    watermark from a model of one — either way,
+    ``paddle_train_hbm_bytes{kind}`` stops reading zero on stats-less
+    hosts. ``{}`` only when no surface yields anything."""
     try:
         ma = compiled.memory_analysis()
     except Exception:
-        return {}
+        ma = None
     if ma is None:
-        return {}
+        return _estimated_memory_stats(compiled, jaxpr)
     out = {}
     for key, attr in _MEM_FIELDS.items():
         try:
@@ -105,3 +114,15 @@ def memory_stats(compiled) -> dict:
                             + out["temp_bytes"] + out["generated_code_bytes"]
                             - out["alias_bytes"])
     return out
+
+
+def _estimated_memory_stats(compiled, jaxpr) -> dict:
+    """The ``estimated=True`` degraded path, in its own frame so the lazy
+    analysis import cannot shadow a real-stats failure (telemetry must not
+    take down the loop it watches)."""
+    try:
+        from ..analysis.hbm import estimate_memory_stats
+
+        return estimate_memory_stats(jaxpr, compiled=compiled)
+    except Exception:
+        return {}

@@ -1,0 +1,23 @@
+"""Seeded violation: one buffer dwarfs the budget — the classic accidental
+giant broadcast (an attention mask or position grid materialized dense
+instead of staying fused/tiled).
+
+``make_program`` is the hbm fixture contract (analysis/hbm.py
+``hbm_fixture_reports``): the traced function broadcasts a 256 B vector to
+a dense 16 MiB [1024, 4096] f32 intermediate before reducing it away, so
+the static liveness walk sees a single 16 MiB live buffer at the peak —
+over 25% of the declared 32 MiB budget. Program-only fixtures zero out the
+pool/params plan (no over-budget, no pool-misfit, no measured stats → no
+drift), so strict fixture mode reports EXACTLY one HIGH: oversized-temp.
+"""
+import jax.numpy as jnp
+
+BUDGET_BYTES = 32 << 20
+
+
+def make_program():
+    def fn(x):
+        dense = jnp.broadcast_to(x[None, :], (1024, 4096))
+        return dense.sum()
+
+    return fn, (jnp.zeros((4096,), jnp.float32),)

@@ -1,0 +1,465 @@
+"""HBM residency lint (ISSUE-14): the static peak-memory estimator, the
+DeploymentPlan budget contract, the seeded fixtures, the CLI legs, the
+allowlist-stale audit, and the planner e2e (a ``plan_kv_pool``-sized
+scheduler serving churn with zero block sheds under the PR-13 sentinel).
+
+The estimator pins are HAND-COMPUTED liveness walks on tiny jaxprs — every
+number in them is derivable on paper from the buffer sizes, which is the
+point: when one breaks, the estimator's semantics changed, not a tolerance.
+All buffers below are 65536-element f32 vectors (B = 262144 bytes) or
+256x256 f32 matrices (M = 262144 bytes) so the arithmetic stays legible.
+"""
+import dataclasses
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.analysis import hbm as H
+from paddle_tpu.analysis.__main__ import main as cli_main
+from paddle_tpu.analysis.compilesurface import ServingConfig
+from paddle_tpu.analysis.core import HIGH, WARN
+from paddle_tpu.analysis.findings import (Allowlist, AllowlistEntry,
+                                          stale_allowlist_findings)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "hbm_fixtures")
+N = 65536                 # f32 elements per test buffer
+B = 4 * N                 # 262144 bytes: one buffer
+
+
+def _real_peak(compiled):
+    """Real backend peak, or None when this jax build has no stats."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    return (int(ma.argument_size_in_bytes) + int(ma.output_size_in_bytes)
+            + int(ma.temp_size_in_bytes)
+            + int(ma.generated_code_size_in_bytes)
+            - int(ma.alias_size_in_bytes))
+
+
+# ===================================================== estimator liveness
+def test_estimator_exact_on_single_dot():
+    """One matmul: peak = both args + the output, nothing ever dies.
+    64x64 f32: 2 x 16384 (args) + 16384 (out) = 49152 — and where the
+    backend reports real stats, the static walk lands on the same number."""
+    f = jax.jit(lambda a, b: a @ b)
+    a = jnp.zeros((64, 64), jnp.float32)
+    cj = jax.make_jaxpr(f)(a, a)
+    est = H.estimate_peak(cj, name="dot")
+    assert est.peak_bytes == 49152
+    assert est.argument_bytes == 32768 and est.output_bytes == 16384
+    real = _real_peak(f.lower(a, a).compile())
+    if real is not None:
+        assert est.peak_bytes == real
+
+
+def test_chain_liveness_releases_dead_temps():
+    """x -> y=x@x -> z=tanh(y) -> w=z@x -> w.sum(): y dies after the tanh,
+    so the watermark is x+y+z = 3M = 786432 at the tanh instant — NOT the
+    4M a walk without last-use release would report."""
+    g = jax.jit(lambda x: (jnp.tanh(x @ x) @ x).sum())
+    x = jnp.zeros((256, 256), jnp.float32)
+    est = H.estimate_peak(jax.make_jaxpr(g)(x), name="chain")
+    assert est.peak_bytes == 3 * B == 786432
+    assert est.temp_bytes == 2 * B        # y and z, never all three temps
+
+
+def test_donated_invar_releases_at_last_use():
+    """donate x in (x+1)*2: x dies after the add, so the peak is x+y (then
+    y+z) = 2B, while the undonated walk pins x to the end for 3B. The
+    donated savings are exactly one buffer — alias_bytes reports it."""
+    f = jax.jit(lambda x: (x + 1.0) * 2.0, donate_argnums=(0,))
+    x = jnp.zeros((N,), jnp.float32)
+    est = H.estimate_peak(jax.make_jaxpr(f)(x), name="donate")
+    assert est.peak_bytes == 2 * B == 524288
+    assert est.peak_bytes_undonated == 3 * B == 786432
+    assert est.alias_bytes == B
+
+
+def test_scan_carry_double_buffers():
+    """scan(c+x) over 4 rows: the body's new carry coexists with the old
+    one for an instant, so the inner extra is exactly one carry buffer on
+    top of args (c0 + xs = 5B) and outs (final carry + stacked ys = 5B):
+    peak = 5B + 5B + B = 11B = 2883584."""
+    def s(c, xs):
+        def body(c, x):
+            c = c + x
+            return c, c
+        return jax.lax.scan(body, c, xs)
+    c0 = jnp.zeros((N,), jnp.float32)
+    xs = jnp.zeros((4, N), jnp.float32)
+    est = H.estimate_peak(jax.make_jaxpr(jax.jit(s))(c0, xs), name="scan")
+    assert est.argument_bytes == 5 * B and est.output_bytes == 5 * B
+    assert est.peak_bytes == 11 * B == 2883584
+    # the carry double-buffer shows up as the scan's internal watermark
+    assert any(b.kind == "internal" and b.bytes == B for b in est.at_peak)
+
+
+def test_cond_inner_extra_is_max_of_branches():
+    """cond(big: x@x temp, small: x.sum()): only the TAKEN-worst branch
+    counts — max over branches, never the sum. Swapping the big branch for
+    a second small one drops the peak by exactly the matmul temp M."""
+    x = jnp.zeros((256, 256), jnp.float32)
+    def big(x):
+        return (x @ x).sum()
+    def small(x):
+        return x.sum()
+    est_big = H.estimate_peak(
+        jax.make_jaxpr(jax.jit(lambda p, x: jax.lax.cond(p, big, small, x)))(
+            True, x), name="cond-big")
+    est_small = H.estimate_peak(
+        jax.make_jaxpr(jax.jit(lambda p, x: jax.lax.cond(p, small, small, x)))(
+            True, x), name="cond-small")
+    assert est_big.peak_bytes == est_small.peak_bytes + B
+
+
+def test_estimate_memory_stats_tiers():
+    """Full tier (jaxpr) mirrors estimate_peak; degraded tier (compiled
+    aval metadata alone) still yields non-zero argument+output bytes.
+    Both are tagged estimated=True — dashboards must be able to tell a
+    modeled watermark from a measured one."""
+    f = jax.jit(lambda a, b: a @ b)
+    a = jnp.zeros((64, 64), jnp.float32)
+    cj = jax.make_jaxpr(f)(a, a)
+    full = H.estimate_memory_stats(cj, name="dot")
+    assert full["estimated"] is True and full["peak_bytes"] == 49152
+    degraded = H.estimate_memory_stats(compiled=f.lower(a, a).compile())
+    assert degraded["estimated"] is True
+    assert degraded["peak_bytes"] >= 49152      # args + outs at minimum
+    assert degraded["argument_bytes"] == 32768
+
+
+def test_xla_memory_stats_falls_back_to_estimator():
+    """A host whose executable has no CompiledMemoryStats (memory_analysis
+    raises) must still feed non-zero hbm numbers: observability/xla.py
+    falls back to the static walk, tagged estimated=True."""
+    from paddle_tpu.observability.xla import memory_stats
+
+    f = jax.jit(lambda a, b: a @ b)
+    a = jnp.zeros((64, 64), jnp.float32)
+    compiled = f.lower(a, a).compile()
+
+    class _StatsLess:
+        def __getattr__(self, name):
+            return getattr(compiled, name)
+
+        def memory_analysis(self):
+            raise NotImplementedError("no stats on this backend")
+
+    stats = memory_stats(_StatsLess(), jax.make_jaxpr(f)(a, a))
+    assert stats.get("estimated") is True
+    assert stats["peak_bytes"] == 49152
+    # degraded tier (no jaxpr): aval metadata alone still lands non-zero
+    stats = memory_stats(_StatsLess())
+    assert stats.get("estimated") is True and stats["peak_bytes"] > 0
+
+
+# ================================================= plan geometry and rules
+def test_per_block_bytes_matches_paged_pool():
+    """The plan-time per-block arithmetic must agree with the pool it
+    models: per_block_bytes(sig) x num_blocks == the real PagedKVCache's
+    resident bytes, exactly."""
+    from paddle_tpu.inference.kv_cache import PagedKVCache
+
+    kv = PagedKVCache(num_layers=2, num_kv_heads=4, head_dim=16,
+                      block_size=8, num_blocks=24, dtype="bfloat16")
+    sig = kv.signature()
+    assert H.per_block_bytes(sig) * kv.num_blocks == kv.per_chip_pool_bytes()
+
+
+def test_plan_kv_pool_clamps_and_floors():
+    per_block = H.per_block_bytes((2, 4, 16, 128, 0, "bfloat16"))  # 64 KiB
+    # generous budget + max_seq_len: the reachable-set clamp wins
+    sizing = H.plan_kv_pool(64 << 20, num_layers=2, num_kv_heads=4,
+                            head_dim=16, block_size=128, slots=4,
+                            max_seq_len=1024)
+    assert sizing["num_blocks"] == sizing["target_blocks"] == 4 * 8
+    assert sizing["per_block_bytes"] == per_block
+    assert sizing["fit_blocks"] > sizing["target_blocks"]
+    assert sizing["plan"].config.kv_signature[4] == 32
+    # tight budget: the fit clamp wins (params eat into the usable bytes)
+    tight = H.plan_kv_pool(int(10 * per_block / 0.92) + 1, num_layers=2,
+                           num_kv_heads=4, head_dim=16, block_size=128,
+                           slots=4, max_seq_len=1024)
+    assert tight["num_blocks"] == tight["fit_blocks"] == 10
+    # a budget that cannot even hold one max-length request is a plan error
+    with pytest.raises(ValueError, match="cannot fit"):
+        H.plan_kv_pool(3 * per_block, num_layers=2, num_kv_heads=4,
+                       head_dim=16, block_size=128, slots=4,
+                       max_seq_len=1024)       # needs blocks_for(1024) = 8
+
+
+def _plan(budget=8 << 20, params=0, slots=4, max_seq_len=1024,
+          nb=32, programs=(), **kw):
+    cfg = ServingConfig(name="syn", slots=slots, max_seq_len=max_seq_len,
+                        kv_signature=(2, 4, 16, 128, nb, "bfloat16"))
+    return H.DeploymentPlan(config=cfg, budget_bytes=budget,
+                            params_bytes=params, programs=tuple(programs),
+                            **kw)
+
+
+def test_plan_components_are_disjoint_and_sum():
+    plan = _plan(params=1 << 20, prefix_blocks=8, temps_bytes=12345)
+    comps = plan.components()
+    assert comps["kv_pool"] == 24 * plan.per_block_bytes
+    assert comps["prefix_tier"] == 8 * plan.per_block_bytes
+    assert comps["params"] == 1 << 20 and comps["temps"] == 12345
+    assert plan.planned_total_bytes == sum(comps.values())
+    assert plan.usable_bytes == int((8 << 20) * 0.92)
+
+
+def test_plan_json_roundtrip_rejects_unknown_fields():
+    prog = H.ProgramEstimate(name="p", peak_bytes=100, temp_bytes=40,
+                             measured_peak_bytes=90)
+    plan = _plan(params=1 << 20, programs=[prog])
+    back = H.DeploymentPlan.from_json(json.loads(json.dumps(plan.to_json())))
+    assert back.components() == plan.components()
+    assert back.programs[0] == prog
+    bad = plan.to_json()
+    bad["gpu_bytes"] = 1
+    with pytest.raises(ValueError, match="unknown DeploymentPlan"):
+        H.DeploymentPlan.from_json(bad)
+    with pytest.raises(ValueError, match="unknown ProgramEstimate"):
+        H.ProgramEstimate.from_json({"name": "p", "peak_bytes": 1,
+                                     "temp_bytes": 0, "color": "red"})
+
+
+def test_rule_over_budget_fires_on_misfit_total():
+    assert list(H._rule_over_budget(_plan(params=1 << 20))) == []
+    found = list(H._rule_over_budget(_plan(budget=2 << 20, params=1 << 20)))
+    assert [f.rule for f in found] == ["hbm-over-budget"]
+    assert found[0].severity == HIGH and "params=" in found[0].message
+
+
+def test_rule_estimate_drift_band_and_floor():
+    def prog(static, real):
+        return H.ProgramEstimate(name="p", peak_bytes=static, temp_bytes=0,
+                                 measured_peak_bytes=real)
+    fire = _plan(programs=[prog(30 << 20, 10 << 20)])   # 3x: outside +/-100%
+    assert [f.rule for f in H._rule_estimate_drift(fire)] == \
+        ["estimate-drift"]
+    ok = _plan(programs=[prog(15 << 20, 10 << 20)])     # within [real/2, 2x]
+    assert list(H._rule_estimate_drift(ok)) == []
+    # outside the band but under the 1 MiB absolute floor: tiny programs
+    # never gate (static 1.2 MiB vs real 0.3 MiB is a 4x ratio, 0.9 MiB)
+    small = _plan(programs=[prog(int(1.2 * 2 ** 20), int(0.3 * 2 ** 20))])
+    assert list(H._rule_estimate_drift(small)) == []
+    # no measured stats on this backend: ungated, never a false positive
+    unmeasured = _plan(programs=[prog(1 << 30, None)])
+    assert list(H._rule_estimate_drift(unmeasured)) == []
+
+
+def test_rule_oversized_temp_severity_tracks_strict():
+    prog = H.ProgramEstimate(name="p", peak_bytes=3 << 20, temp_bytes=3 << 20,
+                             largest_label="broadcast", largest_bytes=3 << 20,
+                             largest_where="model.py:7")
+    plan = _plan(programs=[prog])                 # 3 MiB > 25% of 8 MiB
+    assert [f.severity for f in H._rule_oversized_temp(plan)] == [WARN]
+    assert [f.severity for f in H._rule_oversized_temp(plan, strict=True)] \
+        == [HIGH]
+    under = _plan(budget=16 << 20, programs=[prog])     # 3 MiB < 4 MiB cap
+    assert list(H._rule_oversized_temp(under)) == []
+
+
+def test_rule_pool_misfit_both_arms():
+    # arm A: full concurrency at max length needs more blocks than exist
+    starved = _plan(slots=4, max_seq_len=1024, nb=16)   # need 32 > 16
+    found = list(H._rule_pool_misfit(starved, strict=True))
+    assert [f.rule for f in found] == ["pool-misfit"]
+    assert found[0].severity == HIGH and "exceed" in found[0].message
+    # arm B: blocks no admissible request can ever reach (fixture geometry)
+    wasteful = _plan(slots=2, max_seq_len=256, nb=64, budget=16 << 20)
+    found = list(H._rule_pool_misfit(wasteful))
+    assert [f.severity for f in found] == [WARN]
+    assert "unreachable" in found[0].message
+    # max_seq_len=None: table_width spans the pool, both arms quiet
+    assert list(H._rule_pool_misfit(_plan(max_seq_len=None))) == []
+    # exactly-reachable geometry (the clean fixture's shape): quiet
+    assert list(H._rule_pool_misfit(_plan(), strict=True)) == []
+
+
+def test_analyze_hbm_plan_allowlist_suppresses_and_marks_used():
+    over = _plan(budget=2 << 20, params=1 << 20)
+    entry = AllowlistEntry("hbm-over-budget", subject="syn:*",
+                           reason="known-oversubscribed lab chip")
+    report = H.analyze_hbm_plan(over, allowlist=Allowlist([entry]))
+    assert report.high() == [] and len(report.suppressed) == 1
+    assert entry.used is True
+    assert report.name == "hbm.residency[syn]"
+    assert tuple(report.rules_run) == tuple(H.HBM_RULES)
+
+
+def test_stale_allowlist_audit_flags_only_unused_entries():
+    used = AllowlistEntry("hbm-over-budget", subject="syn:*", reason="lab")
+    dead = AllowlistEntry("pool-misfit", subject="retired-config:*",
+                          reason="decommissioned geometry")
+    al = Allowlist([used, dead])
+    H.analyze_hbm_plan(_plan(budget=2 << 20, params=1 << 20), allowlist=al)
+    stale = stale_allowlist_findings([("hbm", al)])
+    assert [f.rule for f in stale] == ["allowlist-stale"]
+    assert stale[0].severity == WARN
+    assert "retired-config" in stale[0].message
+    assert stale[0].subject == "allowlist:hbm"
+
+
+# ======================================================= fixtures and CLI
+@pytest.mark.parametrize("fixture,rule", [
+    ("over_budget_plan.json", "hbm-over-budget"),
+    ("pool_misfit.json", "pool-misfit"),
+    ("giant_temp_program.py", "oversized-temp"),
+])
+def test_seeded_fixture_trips_exactly_its_rule(fixture, rule):
+    reports = H.hbm_fixture_reports(os.path.join(FIXTURES, fixture))
+    assert len(reports) == 1
+    highs = reports[0].high()
+    assert [f.rule for f in highs] == [rule]
+    assert len(reports[0].findings) == 1        # no WARN riders either
+
+
+def test_clean_fixture_reports_clean():
+    reports = H.hbm_fixture_reports(os.path.join(FIXTURES, "clean_plan.json"))
+    assert [r.findings for r in reports] == [[]]
+
+
+def test_giant_temp_fixture_carries_provenance():
+    (report,) = H.hbm_fixture_reports(
+        os.path.join(FIXTURES, "giant_temp_program.py"))
+    (f,) = report.high()
+    assert "giant_temp_program.py" in f.where   # points at the broadcast
+
+
+def test_cli_hbm_fixture_modes(capsys):
+    assert cli_main(["--hbm", FIXTURES]) == 1            # dir: 3 violations
+    out = capsys.readouterr().out
+    assert "FAIL" in out and "hbm-over-budget" in out
+    assert cli_main(["--hbm",
+                     os.path.join(FIXTURES, "clean_plan.json")]) == 0
+    assert "CLEAN" in capsys.readouterr().out
+    assert cli_main(["--hbm",
+                     os.path.join(FIXTURES, "pool_misfit.json"),
+                     "--json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["status"] == "lint-high" and payload["high_total"] == 1
+    rules = [f["rule"] for r in payload["programs"] for f in r["findings"]]
+    assert rules == ["pool-misfit"]
+
+
+def test_cli_list_rules_catalogs_hbm(capsys):
+    assert cli_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in H.HBM_RULES:
+        assert rule in out
+    assert "[hbm]" in out
+
+
+# =============================================== zoo residency + drift gate
+@pytest.mark.slow
+def test_smoke_plan_residency_clean_and_drift_gated():
+    """The self-check leg, run directly: the zoo GPT's step programs traced
+    against the smoke pool and 64 MiB budget — all four rules quiet, every
+    program's static peak non-zero, and wherever this backend reported real
+    memory_stats the static walk sits inside the drift band (the rule ran
+    and stayed silent, which IS the agreement gate)."""
+    plan = H.smoke_plan()
+    assert len(plan.programs) >= 2
+    names = {p.name for p in plan.programs}
+    assert {"prefill_chunk", "decode_step"} <= names
+    for p in plan.programs:
+        assert p.peak_bytes > 0
+    report = H.analyze_hbm_plan(plan)
+    assert report.findings == [], [f.message for f in report.findings]
+    assert plan.planned_total_bytes <= plan.usable_bytes
+    table = plan.render_table()
+    assert "FIT" in table and "kv_pool" in table
+
+
+@pytest.mark.slow
+def test_zoo_hbm_residency_entry_is_clean():
+    from paddle_tpu.analysis.zoo import ZOO_PROGRAMS
+
+    assert "hbm_residency" in ZOO_PROGRAMS
+    report = ZOO_PROGRAMS["hbm_residency"](None, None)
+    assert report.high() == [], [f.message for f in report.high()]
+
+
+# ====================================================== planner e2e (chaos)
+@pytest.fixture(scope="module")
+def tiny_gpt():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    with paddle.utils.unique_name.guard():
+        paddle.seed(23)
+        m = GPTForCausalLM(GPTConfig(vocab_size=160, hidden_size=64,
+                                     num_layers=2, num_heads=4,
+                                     num_kv_heads=2, max_position=96,
+                                     dropout=0.0))
+    m.eval()
+    return m
+
+
+@pytest.mark.chaos
+def test_hbm_budget_sized_scheduler_serves_churn_without_sheds(tiny_gpt):
+    """The acceptance e2e: a scheduler sized by ``hbm_budget=`` (no
+    num_blocks on faith) serves a mixed-length churn workload with ZERO
+    CacheOutOfBlocks sheds and zero post-warmup recompiles (the chaos mark
+    arms the PR-13 compile sentinel and the lock witness). The pool must
+    land exactly on the reachable-set clamp, the residency gauges must
+    publish the plan, and the plan arithmetic must match the pool built."""
+    from paddle_tpu.inference.scheduler import (
+        ContinuousGenerateBatchingPredictor)
+
+    gp = ContinuousGenerateBatchingPredictor(
+        tiny_gpt, max_slots=2, prefill_chunk=4, decode_steps=2,
+        max_new_tokens=4, decode_kernel="xla", block_size=8,
+        max_seq_len=32, warmup=True, hbm_budget=64 << 20)
+    try:
+        # reachable-set clamp: 2 slots x blocks_for(32/8) = 8 blocks, even
+        # though 64 MiB would fit thousands
+        assert gp.kv_cache.num_blocks == 8
+        plan = gp._hbm_plan
+        assert plan is not None
+        assert plan.kv_pool_component == gp.kv_cache.per_chip_pool_bytes()
+        assert plan.params_component == H.params_bytes_of(tiny_gpt)
+        assert H.analyze_hbm_plan(plan).high() == []
+
+        rng = np.random.default_rng(7)
+        plens = [3, 13, 5, 9, 4, 11]
+        prompts = [rng.integers(0, 160, n).astype("int64") for n in plens]
+        results = {}
+        ts = [threading.Thread(
+            target=lambda i=i: results.update(
+                {i: gp.infer(prompts[i], timeout=300)}))
+            for i in range(len(prompts))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=300)
+        for i, n in enumerate(plens):
+            assert len(results[i]) == n + 4, f"stream {i} truncated"
+
+        snap = gp.metrics.snapshot()
+        assert snap["completed"] == len(prompts)
+        assert snap.get("shed_busy", 0) == 0
+        assert snap.get("shed_unavailable", 0) == 0
+        assert snap.get("rejected_busy", 0) == 0
+        assert gp.kv_cache.blocks_in_use == 0
+        gp.kv_cache.check_conservation()
+
+        text = gp.metrics.registry.render()
+        assert ('paddle_hbm_budget_bytes{component="continuous"} '
+                f"{64 << 20}") in text
+        for part, nbytes in plan.components().items():
+            assert (f'paddle_hbm_planned_bytes{{component="{part}"}} '
+                    f"{nbytes}") in text
+    finally:
+        gp.close()
