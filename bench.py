@@ -900,6 +900,152 @@ def prefix_caching_fields(out):
     return out
 
 
+def bench_multi_lora(on_accel, dev):
+    """Multi-LoRA serving (ISSUE-15 acceptance): one base model + a banked
+    AdapterRegistry serving four adapters at once.
+
+    Two legs over identical traffic (4 adapters x REQS requests, greedy):
+    *batched-heterogeneous* submits everything concurrently so one tick
+    serves four different adapters side by side (the banked gather makes
+    the adapter index a traced input); *sequential per-adapter* drains each
+    adapter's requests before admitting the next — the merged-weights
+    deployment model, where heterogeneity forces serialization. The win is
+    tick sharing: S slots of different adapters cost one program launch.
+
+    Gates (multi_lora_fields): speedup >= 2x, ZERO runner-cache growth
+    across adapter churn (unload + load while serving mixed traffic), and
+    slot-0 (base) output bit-identical to a registry-free scheduler."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.adapters import AdapterRegistry
+    from paddle_tpu.inference.scheduler import (
+        ContinuousGenerateBatchingPredictor,
+    )
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                    num_heads=4, max_position=128)
+    kern = "pallas" if on_accel else "xla"
+    P, NEW, ADAPTERS, REQS = 16, 32, 4, 1
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    reg = AdapterRegistry(model, max_adapters=ADAPTERS, max_rank=8)
+    rng = np.random.RandomState(0)
+
+    def adapter_weights(seed):
+        w = {}
+        r = np.random.RandomState(seed)
+        for p in reg.target_paths():
+            di, do = reg.dims(p)
+            w[p] = (r.randn(di, 4).astype(np.float32) * 0.05,
+                    r.randn(4, do).astype(np.float32) * 0.05)
+        return w
+
+    names = [f"lora-{i}" for i in range(ADAPTERS)]
+    for i, n in enumerate(names):
+        reg.register(n, adapter_weights(100 + i), alpha=8.0)
+    prompts = {n: [rng.randint(0, cfg.vocab_size, P).astype(np.int64)
+                   for _ in range(REQS)] for n in names}
+    base_prompt = rng.randint(0, cfg.vocab_size, P).astype(np.int64)
+
+    sched = ContinuousGenerateBatchingPredictor(
+        model, max_slots=ADAPTERS, prefill_chunk=P, decode_steps=4,
+        max_new_tokens=NEW, decode_kernel=kern, block_size=8,
+        num_blocks=64, max_seq_len=P + NEW, adapters=reg)
+    try:
+        # compile the banked programs once (untimed)
+        sched.infer(base_prompt, timeout=600, max_new_tokens=NEW,
+                    adapter=names[0])
+        cache0 = len(model._runner_cache())
+
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=ADAPTERS * REQS) as pool:
+            def submit(name, ids):
+                return pool.submit(sched.infer, ids, timeout=600,
+                                   max_new_tokens=NEW, adapter=name)
+
+            t0 = time.perf_counter()
+            futs = [submit(n, ids) for n in names for ids in prompts[n]]
+            batched_outs = [f.result() for f in futs]
+            batched_s = time.perf_counter() - t0
+
+            t0 = time.perf_counter()
+            seq_outs = []
+            for n in names:                 # drain one adapter at a time
+                futs = [submit(n, ids) for ids in prompts[n]]
+                seq_outs.extend(f.result() for f in futs)
+            sequential_s = time.perf_counter() - t0
+
+        order_parity = ("ok" if all(
+            np.array_equal(np.asarray(b), np.asarray(s))
+            for b, s in zip(batched_outs, seq_outs)) else "mismatch")
+
+        # adapter churn under traffic: unload/reload must reuse programs
+        reg.unregister(names[-1])
+        reg.register("lora-hot", adapter_weights(999), alpha=8.0)
+        sched.infer(prompts[names[0]][0], timeout=600, max_new_tokens=NEW,
+                    adapter="lora-hot")
+        sched.infer(base_prompt, timeout=600, max_new_tokens=NEW)
+        lora_base_out = sched.infer(base_prompt, timeout=600,
+                                    max_new_tokens=NEW)
+        cache_growth = len(model._runner_cache()) - cache0
+        snap = sched.metrics.snapshot()
+        lora_states = reg.stats()
+    finally:
+        sched.close()
+
+    # slot-0 parity: the same base request through a registry-free
+    # scheduler (bank_sig=None programs) must produce identical tokens
+    plain = ContinuousGenerateBatchingPredictor(
+        model, max_slots=ADAPTERS, prefill_chunk=P, decode_steps=4,
+        max_new_tokens=NEW, decode_kernel=kern, block_size=8,
+        num_blocks=64, max_seq_len=P + NEW)
+    try:
+        base_out = plain.infer(base_prompt, timeout=600, max_new_tokens=NEW)
+    finally:
+        plain.close()
+    slot0_parity = ("ok" if np.array_equal(np.asarray(lora_base_out),
+                                           np.asarray(base_out))
+                    else "mismatch")
+
+    out = dict(snap)
+    out.update(
+        adapters=ADAPTERS, requests_per_adapter=REQS, prompt_tokens=P,
+        new_tokens=NEW, bank_signature=list(reg.signature()),
+        bank_bytes=reg.bank_bytes(), lora_states=lora_states,
+        batched_s=round(batched_s, 4), sequential_s=round(sequential_s, 4),
+        program_cache_growth=int(cache_growth),
+        order_parity=order_parity, slot0_parity=slot0_parity,
+    )
+    multi_lora_fields(out)
+    return out, None
+
+
+def multi_lora_fields(out):
+    """Gate fields for the multi_lora section: sequential/batched wall ->
+    `speedup_batched_over_sequential` (gated >= 2.0 — four adapters sharing
+    ticks should approach 4x over per-adapter draining), plus the audit
+    fold over `program_cache_growth` (must be 0: adapter mix and churn are
+    traced inputs, recompiles mean the bank leaked into a cache key) and
+    `slot0_parity` (base traffic through the banked program must stay
+    bit-identical to the registry-free scheduler). Pure function of the
+    measured dict so tests can pin the wiring on synthetic inputs."""
+    b, s = out.get("batched_s"), out.get("sequential_s")
+    if b and s:
+        out["speedup_batched_over_sequential"] = round(s / b, 2)
+    if ("speedup_batched_over_sequential" in out
+            and "program_cache_growth" in out and "slot0_parity" in out):
+        if out["slot0_parity"] != "ok":
+            out["audit"] = "slot0-parity-mismatch"
+        elif out["program_cache_growth"] != 0:
+            out["audit"] = "recompiled-on-churn"
+        elif out["speedup_batched_over_sequential"] < 2.0:
+            out["audit"] = "no-batching-win"
+        else:
+            out["audit"] = "ok"
+    return out
+
+
 def bench_observability_overhead(on_accel, dev):
     """Instrumentation-cost leg (ISSUE-3): the serving-pressure workload run
     on ONE model with the observability layer enabled (request tracing +
@@ -1730,6 +1876,15 @@ def main():
     except Exception:
         pass
     try:
+        multi_lora, multi_lora_err = bench_multi_lora(on_accel, dev)
+    except Exception as e:
+        multi_lora, multi_lora_err = None, {"error": repr(e)[:200]}
+    gc.collect()
+    try:
+        jax.clear_caches()
+    except Exception:
+        pass
+    try:
         obs, obs_err = bench_observability_overhead(on_accel, dev)
     except Exception as e:
         obs, obs_err = None, {"error": repr(e)[:200]}
@@ -1829,6 +1984,8 @@ def main():
             "mesh_serving": mesh_srv if mesh_srv is not None else mesh_srv_err,
             "speculative_decode": spec if spec is not None else spec_err,
             "prefix_caching": prefix if prefix is not None else prefix_err,
+            "multi_lora": (multi_lora if multi_lora is not None
+                           else multi_lora_err),
             "observability_overhead": obs if obs is not None else obs_err,
             "train_observability_overhead": (train_obs if train_obs is not None
                                              else train_obs_err),

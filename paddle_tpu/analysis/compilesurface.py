@@ -111,9 +111,11 @@ ZOO_FAMILIES = {
     "dense": ("gpt_decode_dense",),
     "paged": ("gpt_decode_paged",),
     "prefill_chunk": ("gpt_prefill_chunk", "gpt_prefill_prefix",
-                      "gpt_prefill_chunk_tp"),
-    "decode_step": ("gpt_decode_step", "gpt_decode_step_tp"),
-    "verify_step": ("gpt_verify_step", "gpt_verify_step_tp"),
+                      "gpt_prefill_chunk_tp", "gpt_prefill_chunk_lora"),
+    "decode_step": ("gpt_decode_step", "gpt_decode_step_tp",
+                    "gpt_decode_step_lora"),
+    "verify_step": ("gpt_verify_step", "gpt_verify_step_tp",
+                    "gpt_verify_step_lora"),
 }
 
 
@@ -314,6 +316,12 @@ class ServingConfig:
     decode_kernel: object = "pallas"
     ids_dtype: str = "int64"
     paths: tuple = ("prefill_chunk", "decode_step")
+    # ISSUE-15 multi-LoRA: AdapterRegistry.signature() — ("lora", bank_rows,
+    # r_max, n_target_paths) — when the deployment serves adapters, else
+    # None (base programs, pre-adapter keys unchanged). The bank SHAPE is
+    # the only adapter fact a cache key may carry: adapter mix/contents are
+    # traced inputs, so churn can never fork programs.
+    adapter_signature: object = None
 
     @property
     def block_size(self) -> int:
@@ -355,6 +363,8 @@ class ServingConfig:
         out = dataclasses.asdict(self)
         out["kv_signature"] = list(self.kv_signature)
         out["paths"] = list(self.paths)
+        if self.adapter_signature is not None:
+            out["adapter_signature"] = list(self.adapter_signature)
         return out
 
     @classmethod
@@ -369,20 +379,25 @@ class ServingConfig:
             kw["kv_signature"] = tuple(kw["kv_signature"])
         if "paths" in kw:
             kw["paths"] = tuple(kw["paths"])
+        if kw.get("adapter_signature") is not None:
+            kw["adapter_signature"] = tuple(kw["adapter_signature"])
         return cls(**kw)
 
 
 # per-path key builders; arity must match the extracted schema (drift gate)
 _KEY_BUILDERS = {
-    "prefill_chunk": (8, lambda c: (
+    "prefill_chunk": (9, lambda c: (
         "prefill_chunk", c.slots, c.prefill_chunk, c.table_width,
-        c.kv_signature, c.eos, c.ids_dtype, c.decode_kernel)),
-    "decode_step": (8, lambda c: (
+        c.kv_signature, c.eos, c.ids_dtype, c.decode_kernel,
+        c.adapter_signature)),
+    "decode_step": (9, lambda c: (
         "decode_step", c.slots, c.decode_steps, c.table_width,
-        c.kv_signature, c.eos, c.ids_dtype, c.decode_kernel)),
-    "verify_step": (7, lambda c: (
+        c.kv_signature, c.eos, c.ids_dtype, c.decode_kernel,
+        c.adapter_signature)),
+    "verify_step": (8, lambda c: (
         "verify_step", c.slots, c.spec_k + 1, c.table_width,
-        c.kv_signature, c.ids_dtype, c.decode_kernel)),
+        c.kv_signature, c.ids_dtype, c.decode_kernel,
+        c.adapter_signature)),
 }
 
 
@@ -476,11 +491,14 @@ def default_serving_configs():
     """The deployment shapes the shipped serving defaults produce, at the
     zoo smoke pool geometry (analysis/zoo.py _continuous_smoke): the
     continuous scheduler's default knobs over the 2-layer GPT smoke pool,
-    with and without speculative decoding. These are what --self-check
-    lints and what the default manifest covers."""
+    with and without speculative decoding, plus the multi-LoRA shape the
+    zoo's adapter-indexed entries build (4 adapters + identity, rank 8,
+    4 target projections on the 2-layer smoke GPT)."""
     base = ServingConfig(name="continuous-default")
     return (base,
-            dataclasses.replace(base, name="continuous-spec", spec_k=4))
+            dataclasses.replace(base, name="continuous-spec", spec_k=4),
+            dataclasses.replace(base, name="continuous-lora",
+                                adapter_signature=("lora", 5, 8, 4)))
 
 
 def default_manifest() -> ProgramManifest:

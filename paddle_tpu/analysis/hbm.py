@@ -31,8 +31,9 @@ halves, same shape as every prior lint (hazard checkable before deploy):
    ``ServingConfig`` (reusing the ISSUE-13 config → program-inventory
    derivation): params/tp (optimizer-free serving state), the
    ``PagedKVCache`` pool per chip, a prefix-cache parked tier carved out
-   of the pool, and the max static temp peak across every manifest
-   program — evaluated against a declared chip HBM budget with headroom.
+   of the pool, the max static temp peak across every manifest
+   program, and (ISSUE-15) the resident multi-LoRA adapter banks — all
+   evaluated against a declared chip HBM budget with headroom.
 
 Rules (shared Finding/Allowlist machinery):
 
@@ -57,8 +58,9 @@ provides them); ``--hbm [NAME|FILE.json]`` prints the residency table (the
 deploy-review artifact) or runs seeded fixtures strict; ``plan_kv_pool``
 is the runtime half — the continuous scheduler's ``hbm_budget=`` knob
 sizes its pool from the plan and publishes
-``paddle_hbm_planned_bytes{component=params|kv_pool|prefix_tier|temps}``
-next to ``paddle_hbm_budget_bytes`` so a scrape shows plan vs actual.
+``paddle_hbm_planned_bytes{component=params|kv_pool|prefix_tier|temps|``
+``adapter_bank}`` next to ``paddle_hbm_budget_bytes`` so a scrape shows
+plan vs actual.
 """
 from __future__ import annotations
 
@@ -507,6 +509,7 @@ class DeploymentPlan:
     prefix_blocks: int = 0
     programs: tuple = ()                 # ProgramEstimate per manifest entry
     temps_bytes: int = 0                 # declared floor when no programs
+    adapter_bank_bytes: int = 0          # ISSUE-15: resident LoRA banks
 
     def __post_init__(self):
         if self.budget_bytes <= 0:
@@ -548,12 +551,20 @@ class DeploymentPlan:
         temps = [p.temp_bytes for p in self.programs]
         return max([int(self.temps_bytes)] + temps)
 
+    @property
+    def adapter_bank_component(self) -> int:
+        # the full fixed-shape banks (AdapterRegistry.bank_bytes()) — HBM
+        # is paid for A_max slots up front whether or not they're loaded,
+        # which is exactly why the plan must carry it (ISSUE-15)
+        return int(self.adapter_bank_bytes)
+
     def components(self) -> dict:
         return {
             "params": self.params_component,
             "kv_pool": self.kv_pool_component,
             "prefix_tier": self.prefix_tier_component,
             "temps": self.temps_component,
+            "adapter_bank": self.adapter_bank_component,
         }
 
     @property
@@ -571,6 +582,7 @@ class DeploymentPlan:
             "prefix_blocks": int(self.prefix_blocks),
             "programs": [p.to_json() for p in self.programs],
             "temps_bytes": int(self.temps_bytes),
+            "adapter_bank_bytes": int(self.adapter_bank_bytes),
             "components": self.components(),
             "planned_total_bytes": self.planned_total_bytes,
         }
@@ -749,8 +761,8 @@ def params_bytes_of(model) -> int:
 def plan_kv_pool(budget_bytes, *, num_layers, num_kv_heads, head_dim,
                  block_size, dtype="bfloat16", slots=8, max_seq_len=None,
                  params_bytes=0, tp=1, headroom=DEFAULT_HEADROOM,
-                 prefix_blocks=0, temps_bytes=0, name="planned",
-                 prefill_chunk=16, decode_steps=4, spec_k=0,
+                 prefix_blocks=0, temps_bytes=0, adapter_bank_bytes=0,
+                 name="planned", prefill_chunk=16, decode_steps=4, spec_k=0,
                  eos_token_id=None, decode_kernel="pallas") -> dict:
     """Size a PagedKVCache pool from an HBM budget: the runtime half the
     continuous scheduler's ``hbm_budget=`` knob consults before building
@@ -767,7 +779,8 @@ def plan_kv_pool(budget_bytes, *, num_layers, num_kv_heads, head_dim,
 
     budget_bytes = int(budget_bytes)
     usable = int(budget_bytes * (1.0 - headroom))
-    fixed = int(params_bytes) // max(1, int(tp)) + int(temps_bytes)
+    fixed = (int(params_bytes) // max(1, int(tp)) + int(temps_bytes)
+             + int(adapter_bank_bytes))
     sig = (int(num_layers), int(num_kv_heads), int(head_dim),
            int(block_size), 0, str(dtype))
     pbb = per_block_bytes(sig, tp=tp)
@@ -794,7 +807,8 @@ def plan_kv_pool(budget_bytes, *, num_layers, num_kv_heads, head_dim,
     plan = DeploymentPlan(
         config=config, budget_bytes=budget_bytes, headroom=headroom,
         params_bytes=int(params_bytes), tp=int(tp),
-        prefix_blocks=int(prefix_blocks), temps_bytes=int(temps_bytes))
+        prefix_blocks=int(prefix_blocks), temps_bytes=int(temps_bytes),
+        adapter_bank_bytes=int(adapter_bank_bytes))
     return {"num_blocks": num_blocks, "fit_blocks": int(fit),
             "target_blocks": target, "per_block_bytes": pbb, "plan": plan}
 

@@ -78,6 +78,7 @@ RUNTIME_MODULES = (
     "inference/scheduler.py",
     "inference/kv_cache.py",
     "inference/prefix_cache.py",
+    "inference/adapters.py",
     "inference/resilience.py",
     "inference/faults.py",
     "framework/checkpoint.py",

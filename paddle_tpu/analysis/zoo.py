@@ -395,6 +395,137 @@ def gpt_verify_step_tp_report(thresholds=None, allowlist=None):
                                allowlist)
 
 
+def _continuous_lora_smoke():
+    """The continuous smoke pool wrapped by an AdapterRegistry (ISSUE-15):
+    4 adapter rows + the identity slot, rank 8, over the smoke GPT's 4
+    target projections — exactly the ("lora", 5, 8, 4) signature the
+    continuous-lora ServingConfig declares. One real rank-4 adapter is
+    registered and routed to the live slot so the banked gather lints with
+    a non-identity row in flight."""
+    model, kv, tbl, ids, S, C, NEW, T, jnp = _continuous_smoke()
+    from paddle_tpu.inference.adapters import AdapterRegistry
+
+    reg = AdapterRegistry(model, max_adapters=4, max_rank=8)
+    rs = np.random.RandomState(7)
+    weights = {}
+    for path in reg.target_paths():
+        d_in, d_out = reg.dims(path)
+        weights[path] = (rs.randn(d_in, 4).astype(np.float32) * 0.02,
+                         rs.randn(4, d_out).astype(np.float32) * 0.02)
+    row = reg.register("zoo-adapter", weights, alpha=8.0)
+    aidx = np.zeros(S, np.int32)
+    aidx[0] = row                   # live slot adapted, idle slot identity
+    return model, kv, tbl, ids, S, C, NEW, T, jnp, reg, aidx
+
+
+def gpt_prefill_chunk_lora_report(thresholds=None, allowlist=None):
+    """Chunked prefill with the banked LoRA gather traced in (ISSUE-15).
+
+    The adapter index and the parameter bank are ARGUMENTS of the step
+    program — like the PR-8 sampler knobs, any adapter mix, load, or
+    unload reuses this one program; only the bank SHAPE is in the cache
+    key. The lint proves the gathered delta path introduces no new
+    donation or layout hazards over the base entry."""
+    import jax
+
+    from .core import analyze
+
+    (model, kv, tbl, ids, S, C, NEW, T, jnp,
+     reg, aidx) = _continuous_lora_smoke()
+    offs = np.zeros(S, np.int64)
+    lens = np.asarray([C, 0], np.int64)          # slot 1 idle (masked)
+    model.prefill_chunk(ids, offs, lens, kv, tbl,
+                        adapters=reg, adapter_slots=aidx)
+    run = model.compiled_prefill_chunk_runner(
+        S, C, adapter_signature=reg.signature())
+    return analyze(
+        run, model._decode_state(jnp.bfloat16), jnp.asarray(ids),
+        jnp.asarray(offs, jnp.int32), jnp.asarray(lens, jnp.int32),
+        jnp.asarray(tbl, jnp.int32),
+        jnp.zeros((S,), jnp.float32), jnp.zeros((S,), jnp.int32),
+        tuple(kv.k_pages), tuple(kv.v_pages),
+        jnp.asarray(aidx, jnp.int32), reg.bank(),
+        jax.random.key(0),
+        _name="gpt.decode.paged_prefill_chunk_lora",
+        _arg_labels=("state", "chunk", "offsets", "chunk_lens", "tables",
+                     "temperatures", "top_ks", "k_pages", "v_pages",
+                     "adapter_slots", "bank", "rng_key"),
+        _thresholds=thresholds, _allowlist=allowlist)
+
+
+def gpt_decode_step_lora_report(thresholds=None, allowlist=None):
+    """The decode tick with the banked LoRA gather traced in — the program
+    every heterogeneous-adapter batch launches per token (ISSUE-15)."""
+    import jax
+
+    from .core import analyze
+
+    (model, kv, tbl, ids, S, C, NEW, T, jnp,
+     reg, aidx) = _continuous_lora_smoke()
+    model.prefill_chunk(ids, np.zeros(S, np.int64),
+                        np.asarray([C, 0], np.int64), kv, tbl,
+                        adapters=reg, adapter_slots=aidx)
+    tok = np.zeros(S, np.int64)
+    lens = np.asarray([C, 0], np.int64)
+    act = np.asarray([True, False])
+    lmax = np.asarray([C + NEW, 0], np.int64)
+    model.decode_step(tok, lens, act, kv, tbl, steps=T, max_lens=lmax,
+                      adapters=reg, adapter_slots=aidx)
+    run = model.compiled_decode_step_runner(
+        S, T, adapter_signature=reg.signature())
+    return analyze(
+        run, model._decode_state(jnp.bfloat16), jnp.asarray(tok),
+        jnp.asarray(lens, jnp.int32), jnp.asarray(act),
+        jnp.asarray(lmax, jnp.int32), jnp.asarray(tbl, jnp.int32),
+        jnp.zeros((S,), jnp.float32), jnp.zeros((S,), jnp.int32),
+        tuple(kv.k_pages), tuple(kv.v_pages),
+        jnp.asarray(aidx, jnp.int32), reg.bank(), jax.random.key(0),
+        _name="gpt.decode.paged_step_lora",
+        _arg_labels=("state", "tokens", "lengths", "active", "max_lens",
+                     "tables", "temperatures", "top_ks", "k_pages",
+                     "v_pages", "adapter_slots", "bank", "rng_key"),
+        _thresholds=thresholds, _allowlist=allowlist)
+
+
+def gpt_verify_step_lora_report(thresholds=None, allowlist=None):
+    """The speculative verifier with the banked LoRA gather traced in —
+    draft acceptance under an adapted target model (ISSUE-15)."""
+    import jax
+
+    from .core import analyze
+
+    (model, kv, tbl, ids, S, C, NEW, T, jnp,
+     reg, aidx) = _continuous_lora_smoke()
+    model.prefill_chunk(ids, np.zeros(S, np.int64),
+                        np.asarray([C, 0], np.int64), kv, tbl,
+                        adapters=reg, adapter_slots=aidx)
+    K = 3
+    chunk = np.zeros((S, K + 1), np.int64)
+    chunk[0] = np.random.RandomState(1).randint(0, 512, K + 1)
+    offs = np.asarray([C, 0], np.int64)
+    dlens = np.asarray([K, 0], np.int64)
+    act = np.asarray([True, False])
+    lmax = np.asarray([C + NEW, 0], np.int64)
+    model.verify_step(chunk, offs, dlens, act, kv, tbl, max_lens=lmax,
+                      adapters=reg, adapter_slots=aidx)
+    run = model.compiled_verify_step_runner(
+        S, K + 1, adapter_signature=reg.signature())
+    return analyze(
+        run, model._decode_state(jnp.bfloat16), jnp.asarray(chunk),
+        jnp.asarray(offs, jnp.int32), jnp.asarray(dlens, jnp.int32),
+        jnp.asarray(act), jnp.asarray(lmax, jnp.int32),
+        jnp.asarray(tbl, jnp.int32),
+        jnp.zeros((S,), jnp.float32), jnp.zeros((S,), jnp.int32),
+        tuple(kv.k_pages), tuple(kv.v_pages),
+        jnp.asarray(aidx, jnp.int32), reg.bank(), jax.random.key(0),
+        _name="gpt.decode.paged_verify_step_lora",
+        _arg_labels=("state", "chunk", "offsets", "draft_lens", "active",
+                     "max_lens", "tables", "temperatures", "top_ks",
+                     "k_pages", "v_pages", "adapter_slots", "bank",
+                     "rng_key"),
+        _thresholds=thresholds, _allowlist=allowlist)
+
+
 def compile_surface_report(thresholds=None, allowlist=None):
     """The compile-surface contract (ISSUE-13): not a traced program but
     the inventory OVER the decode programs above — AST-extract every
@@ -438,6 +569,9 @@ ZOO_PROGRAMS = {
     "gpt_prefill_chunk_tp": gpt_prefill_chunk_tp_report,
     "gpt_decode_step_tp": gpt_decode_step_tp_report,
     "gpt_verify_step_tp": gpt_verify_step_tp_report,
+    "gpt_prefill_chunk_lora": gpt_prefill_chunk_lora_report,
+    "gpt_decode_step_lora": gpt_decode_step_lora_report,
+    "gpt_verify_step_lora": gpt_verify_step_lora_report,
     "compile_surface": compile_surface_report,
     "hbm_residency": hbm_residency_report,
 }

@@ -1,0 +1,342 @@
+"""Multi-LoRA serving: a banked adapter store over one base model (ISSUE-15).
+
+Punica / S-LoRA architecture: hundreds of LoRA adapters share a single set
+of base weights by keeping every adapter's low-rank factors in fixed-shape
+*banks* — one pair of arrays per target projection,
+
+    A_bank[path]: [A_max + 1, in_features,  r_max]
+    B_bank[path]: [A_max + 1, r_max, out_features]
+
+padded per-adapter (rank <= r_max, alpha/r folded into B at load time).
+The step programs in models/generation.py take a traced ``[S]`` adapter
+index: each slot gathers its ``(A_i, B_i)`` rows and applies
+``y += (x @ A_i) @ B_i`` on the target matmuls. Because the banks and the
+index are *inputs*, not constants, adapter mix changes, admit/retire and
+load/unload NEVER recompile — the compile cache key carries only the bank
+SHAPE (``signature()``), pinned under the PR-13 sentinel.
+
+Bank slot 0 is reserved as the identity adapter (all-zero factors): base
+model requests ride the very same program and pay one zero-delta gather,
+which is what makes slot-0 traffic bit-identical to the pre-LoRA scheduler.
+
+Injection is a forward-post hook on each target sublayer, gated by a
+ContextVar that is only set (by ``applied``) while a step program TRACES:
+training, dense generate and every other path see ``None`` and the hook is
+a no-op. Compiled executions never re-enter Python — the hook's tracers are
+function arguments, so new bank values flow in per launch.
+
+Lifecycle (all under one ``make_rlock`` — this module is thread-lint
+RUNTIME_MODULES): ``register`` loads factors into a free slot and stamps a
+fresh uid seed (the prefix-cache digest-chain seed, so KV blocks prefilled
+under adapter A never match adapter B, base, or a later re-registration
+under the same name); ``unregister`` unmaps the name immediately and frees
+the slot when its refcount drains — an unload never races an in-flight
+request because admission holds a ref until the slot retires.
+
+Fault site: ``lora.load`` (entry of ``register``, before any bank
+mutation — an injected error models a corrupt adapter artifact).
+
+Scope: data-parallel serving. Under tensor parallelism the target
+projections shard their output dim, so the bank's ``B`` rows would need the
+same sharding — documented out of scope (DEPLOYMENT.md round 15).
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import itertools
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..analysis.lockwitness import make_rlock
+from ..tensor import Tensor
+
+__all__ = ["AdapterRegistry", "applied", "BASE_SLOT"]
+
+# slot 0 = identity (zero-delta) adapter: base-model traffic's bank row
+BASE_SLOT = 0
+
+# (bank, adapter_index) while a LoRA-enabled step program traces; None on
+# every other path (training, dense generate, base-only step programs) so
+# the hooks below are inert unless `applied` wraps the traced call
+_ACTIVE = contextvars.ContextVar("paddle_lora_active", default=None)
+
+
+@contextlib.contextmanager
+def applied(bank, adapter_slots):
+    """Arm the LoRA hooks for the duration of a traced model call.
+
+    `bank` is AdapterRegistry.bank() (or tracers thereof inside jit);
+    `adapter_slots` is the [S] int32 per-slot bank index."""
+    token = _ACTIVE.set((bank, adapter_slots))
+    try:
+        yield
+    finally:
+        _ACTIVE.reset(token)
+
+
+def _delta_hook(path):
+    """Forward-post hook for one target projection: gather the slot's
+    low-rank factors from the bank and add ``(x @ A) @ B`` to the output.
+    Returns None (hook no-op) whenever no LoRA context is active."""
+
+    def hook(layer, inputs, outputs):
+        active = _ACTIVE.get()
+        if active is None:
+            return None
+        bank, aidx = active
+        x = inputs[0]._value if isinstance(inputs[0], Tensor) else inputs[0]
+        y = outputs._value if isinstance(outputs, Tensor) else outputs
+        # compute in the activation dtype: the bank casts DOWN to x.dtype
+        # (never x up to f32 — that would halve MXU throughput and trip
+        # the dtype-upcast lint); matmul precision "highest" still gives
+        # f32 accumulation inside the rank-r dots
+        a = jnp.take(bank["a"][path], aidx, axis=0)   # [S, in, r_max]
+        b = jnp.take(bank["b"][path], aidx, axis=0)   # [S, r_max, out]
+        delta = jnp.einsum("s...i,sir->s...r", x, a.astype(x.dtype))
+        delta = jnp.einsum("s...r,sro->s...o", delta, b.astype(x.dtype))
+        return Tensor(y + delta.astype(y.dtype))
+
+    return hook
+
+
+class _Slot:
+    """One occupied bank row: name -> (refcount, drain flag, digest seed)."""
+
+    __slots__ = ("name", "seed", "refs", "draining")
+
+    def __init__(self, name, seed):
+        self.name = name
+        self.seed = seed
+        self.refs = 0
+        self.draining = False
+
+
+class AdapterRegistry:
+    """Fixed-shape banked LoRA store + hook installer for one base model.
+
+    `targets` are sublayer attribute names; every sublayer of
+    ``model._decode_layer()`` whose path ends in one of them becomes a LoRA
+    target (for the GPT family: ``qkv_proj`` plus the FFN up-projection).
+    Bank shapes are fixed at construction — ``max_adapters`` loadable
+    adapters (slot 0 is the reserved identity) of rank <= ``max_rank``."""
+
+    def __init__(self, model, *, max_adapters=8, max_rank=8,
+                 targets=("qkv_proj", "gate_up", "fc1"), dtype="float32",
+                 faults=None):
+        if max_adapters < 1:
+            raise ValueError("max_adapters must be >= 1")
+        if max_rank < 1:
+            raise ValueError("max_rank must be >= 1")
+        self._lock = make_rlock("adapters.AdapterRegistry._lock")
+        self._faults = faults           # FaultInjector | None (lora.load)
+        self._rows = int(max_adapters) + 1          # + identity slot 0
+        self._r_max = int(max_rank)
+        self._dtype = jnp.dtype(dtype)
+        self._uid = itertools.count(1)
+        root = model._decode_layer()
+        self._dims = {}                              # path -> (in, out)
+        self._hooks = []
+        for path, layer in root.named_sublayers():
+            if path.split(".")[-1] not in targets:
+                continue
+            w = getattr(layer, "weight", None)
+            if w is None:
+                continue
+            in_f, out_f = int(w.shape[0]), int(w.shape[1])
+            self._dims[path] = (in_f, out_f)
+            self._hooks.append(layer.register_forward_post_hook(
+                _delta_hook(path)))
+        if not self._dims:
+            raise ValueError(
+                f"no LoRA targets matched {targets!r} in the model")
+        self._a = {p: jnp.zeros((self._rows, i, self._r_max), self._dtype)
+                   for p, (i, o) in self._dims.items()}
+        self._b = {p: jnp.zeros((self._rows, self._r_max, o), self._dtype)
+                   for p, (i, o) in self._dims.items()}
+        self._names = {}                             # name -> bank row
+        self._slots = [None] * self._rows            # row -> _Slot | None
+        self._loads = itertools.count()              # lifetime registers
+
+    # ------------------------------------------------------------ identity
+    def signature(self):
+        """Bank SHAPE key: the only thing the compile cache may depend on.
+        (rows, r_max, n_target_paths) — adapter contents and mix stay
+        traced, so load/unload/churn never shows up here."""
+        return ("lora", self._rows, self._r_max, len(self._dims))
+
+    def bank(self):
+        """Stable-structure pytree of the current bank arrays. Dict keys are
+        the fixed target-path set, so the pytree structure (and therefore
+        the compiled program) is identical across every load/unload."""
+        with self._lock:
+            return {"a": dict(self._a), "b": dict(self._b)}
+
+    def bank_bytes(self):
+        """HBM residency of the banks (the DeploymentPlan `adapter_bank`
+        component)."""
+        item = self._dtype.itemsize
+        return sum(self._rows * (i * self._r_max + self._r_max * o) * item
+                   for i, o in self._dims.values())
+
+    def target_paths(self):
+        return tuple(sorted(self._dims))
+
+    def dims(self, path):
+        """`(in_features, out_features)` of a target path."""
+        return self._dims[path]
+
+    # ------------------------------------------------------------ lifecycle
+    def _resolve(self, key):
+        """Map a weights key (exact path or unique suffix) to a target."""
+        if key in self._dims:
+            return key
+        cands = [p for p in self._dims
+                 if p == key or p.endswith("." + key)]
+        if len(cands) == 1:
+            return cands[0]
+        if not cands:
+            raise ValueError(
+                f"unknown LoRA target {key!r}; targets: "
+                f"{sorted(self._dims)}")
+        raise ValueError(
+            f"ambiguous LoRA target {key!r} matches {sorted(cands)}")
+
+    def register(self, name, weights, alpha=1.0):
+        """Load an adapter into a free bank slot.
+
+        `weights` maps target path (or unique suffix) to an ``(A, B)`` pair
+        with A ``[in, r]`` and B ``[r, out]``, r <= max_rank; ``alpha/r`` is
+        folded into B here so the traced gather applies plain ``x@A@B``.
+        Partial targeting is fine — untouched targets keep zero factors."""
+        if self._faults is not None:
+            self._faults.check("lora.load")
+        if not weights:
+            raise ValueError("empty adapter weights")
+        resolved = {}
+        for key, (a, b) in weights.items():
+            path = self._resolve(key)
+            a = np.asarray(a, np.float32)
+            b = np.asarray(b, np.float32)
+            in_f, out_f = self._dims[path]
+            if a.ndim != 2 or b.ndim != 2 or a.shape[1] != b.shape[0]:
+                raise ValueError(
+                    f"adapter {name!r} target {path!r}: A {a.shape} / "
+                    f"B {b.shape} are not a rank factorization")
+            r = a.shape[1]
+            if r < 1 or r > self._r_max:
+                raise ValueError(
+                    f"adapter {name!r} target {path!r}: rank {r} outside "
+                    f"1..{self._r_max}")
+            if a.shape[0] != in_f or b.shape[1] != out_f:
+                raise ValueError(
+                    f"adapter {name!r} target {path!r}: expected A "
+                    f"[{in_f}, r] / B [r, {out_f}], got {a.shape} / "
+                    f"{b.shape}")
+            resolved[path] = (a, b * (float(alpha) / r), r)
+        with self._lock:
+            if name in self._names:
+                raise ValueError(f"adapter {name!r} already loaded")
+            row = next((i for i in range(1, self._rows)
+                        if self._slots[i] is None), None)
+            if row is None:
+                raise RuntimeError(
+                    f"adapter bank full ({self._rows - 1} slots); "
+                    "unregister one first or size max_adapters up")
+            uid = next(self._uid)
+            seed = f"lora:{name}:{uid}".encode()
+            for path, (a, b, r) in resolved.items():
+                a_pad = np.zeros(self._a[path].shape[1:], np.float32)
+                b_pad = np.zeros(self._b[path].shape[1:], np.float32)
+                a_pad[:, :r] = a
+                b_pad[:r, :] = b
+                self._a[path] = self._a[path].at[row].set(
+                    jnp.asarray(a_pad, self._dtype))
+                self._b[path] = self._b[path].at[row].set(
+                    jnp.asarray(b_pad, self._dtype))
+            self._slots[row] = _Slot(name, seed)
+            self._names[name] = row
+            next(self._loads)
+            return row
+
+    def unregister(self, name):
+        """Unmap `name` now; free its slot when in-flight refs drain.
+
+        New admissions fail immediately (the name is gone), requests already
+        holding the slot keep valid factors until release() — an unload can
+        never corrupt a running batch."""
+        with self._lock:
+            row = self._names.pop(name, None)
+            if row is None:
+                raise ValueError(f"unknown adapter {name!r}")
+            slot = self._slots[row]
+            if slot.refs <= 0:
+                self._free(row)
+            else:
+                slot.draining = True
+            return row
+
+    def _free(self, row):
+        # zero the rows: a freed slot behaves as identity until reused, so
+        # a stale index (can't happen via acquire/release, but cheap to
+        # make harmless) adds nothing. Callers hold the lock; re-entering
+        # the rlock here keeps the lockset visibly consistent.
+        with self._lock:
+            for path in self._a:
+                self._a[path] = self._a[path].at[row].set(0)
+                self._b[path] = self._b[path].at[row].set(0)
+            self._slots[row] = None
+
+    # ------------------------------------------------------------ request path
+    def has(self, name):
+        with self._lock:
+            return name in self._names
+
+    def names(self):
+        with self._lock:
+            return sorted(self._names)
+
+    def acquire(self, name):
+        """Admission-side pin: (bank row, digest seed) with the row's
+        refcount bumped. `name=None` is the base model — slot 0, empty
+        seed, never refcounted (identity is always resident)."""
+        if name is None:
+            return BASE_SLOT, b""
+        with self._lock:
+            row = self._names.get(name)
+            if row is None:
+                raise ValueError(f"unknown adapter {name!r}")
+            self._slots[row].refs += 1
+            return row, self._slots[row].seed
+
+    def release(self, row):
+        """Retirement-side unpin; idempotent for slot 0 and freed rows."""
+        if row == BASE_SLOT:
+            return
+        with self._lock:
+            slot = self._slots[row]
+            if slot is None:
+                return
+            slot.refs = max(0, slot.refs - 1)
+            if slot.draining and slot.refs == 0:
+                self._free(row)
+
+    # ------------------------------------------------------------ observability
+    def stats(self):
+        """{loaded, pinned, free} for the paddle_lora_adapters gauge."""
+        with self._lock:
+            occupied = [s for s in self._slots[1:] if s is not None]
+            return {
+                "loaded": len(occupied),
+                "pinned": sum(1 for s in occupied if s.refs > 0),
+                "free": (self._rows - 1) - len(occupied),
+            }
+
+    def close(self):
+        """Detach the forward-post hooks (tests; a registry outliving its
+        model would otherwise keep firing no-op hooks)."""
+        with self._lock:
+            hooks, self._hooks = self._hooks, []
+        for h in hooks:
+            h.remove()

@@ -30,6 +30,10 @@ site                   where it is checked
 ``predictor.run``      immediately before ``predictor.run`` (dense path)
 ``predictor.generate`` immediately before ``model.generate_paged`` /
                        the dense-fallback ``model.generate``
+``lora.load``          entry of ``AdapterRegistry.register`` (ISSUE-15),
+                       before any bank mutation — an injected error models
+                       a corrupt adapter artifact; in-flight traffic and
+                       already-loaded adapters must be untouched
 =====================  =====================================================
 
 Training-side sites (``framework/checkpoint.py`` — pass ``injector=`` to the

@@ -102,14 +102,20 @@ class PrefixCache:
         kv_cache.attach_prefix_cache(self)
 
     # -------------------------------------------------------------- hashing
-    def hash_blocks(self, tokens) -> list:
+    def hash_blocks(self, tokens, seed=b"") -> list:
         """Chain digests for every FULL block of ``tokens``. Digest ``i``
         commits to all tokens in blocks ``0..i`` — equal digests mean equal
-        prefixes (up to blake2b collisions, which we accept at 128 bits)."""
+        prefixes (up to blake2b collisions, which we accept at 128 bits).
+
+        ``seed`` roots the chain (ISSUE-15): the scheduler passes the
+        request's adapter uid so KV rows prefilled under adapter A can never
+        match a lookup under adapter B or base — same tokens, different
+        model. Base requests keep the empty seed, so their digests are
+        byte-identical to the pre-adapter chain."""
         toks = np.ascontiguousarray(np.asarray(tokens, np.int64))
         bs = self.block_size
         out = []
-        parent = b""
+        parent = bytes(seed)
         for i in range(len(toks) // bs):
             h = hashlib.blake2b(parent, digest_size=_DIGEST_BYTES)
             h.update(toks[i * bs:(i + 1) * bs].tobytes())
@@ -118,7 +124,7 @@ class PrefixCache:
         return out
 
     # --------------------------------------------------------------- lookup
-    def lookup(self, prompt) -> PrefixHit:
+    def lookup(self, prompt, seed=b"") -> PrefixHit:
         """Longest indexed chain over the prompt's full blocks, capped at
         ``plen - 1`` tokens (see module docstring: the last prompt token
         must re-prefill so its logits exist to sample from). Takes only the
@@ -128,7 +134,7 @@ class PrefixCache:
             self._faults.check("kv.prefix_match")
         prompt = np.asarray(prompt).reshape(-1)
         n_match = max(0, (len(prompt) - 1) // self.block_size)
-        digests = self.hash_blocks(prompt)
+        digests = self.hash_blocks(prompt, seed=seed)
         pairs = []
         with self._lock:
             now = next(self._clock)
@@ -145,7 +151,8 @@ class PrefixCache:
         return PrefixHit(digests, pairs)
 
     # ------------------------------------------------------------- indexing
-    def register(self, request_id, tokens, digests=None, length=None) -> int:
+    def register(self, request_id, tokens, digests=None, length=None,
+                 seed=b"") -> int:
         """Index ``request_id``'s full, COMMITTED blocks under their content
         digests; returns how many new entries landed. Only rows actually
         written to the pool are indexable: the cap is the kv-side committed
@@ -168,7 +175,8 @@ class PrefixCache:
                 return 0
             if digests is None:
                 digests = self.hash_blocks(
-                    np.asarray(tokens)[: n_full * self.block_size])
+                    np.asarray(tokens)[: n_full * self.block_size],
+                    seed=seed)
             if len(digests) < n_full:
                 raise ValueError(
                     f"register: {len(digests)} digests for {n_full} blocks")

@@ -77,7 +77,7 @@ class _SlotSeq:
     __slots__ = ("req", "rid", "ids", "out_dtype", "plen", "pos", "tok",
                  "length", "generated", "table", "phase", "max_new", "order",
                  "temperature", "top_k", "spec", "prefix_hit", "digests",
-                 "flushed")
+                 "flushed", "adapter", "adapter_seed")
 
     def __init__(self, req, rid, ids, out_dtype, max_new, order):
         self.req = req
@@ -108,6 +108,12 @@ class _SlotSeq:
         self.prefix_hit = 0
         self.digests = None
         self.flushed = 0
+        # per-request model delta (ISSUE-15): the adapter's bank row (0 =
+        # base/identity) — a traced [S] step-program input, so heterogeneous
+        # adapter mixes share one compiled program — and its registration
+        # uid, which seeds the prefix-cache digest chain (KV isolation)
+        self.adapter = 0
+        self.adapter_seed = b""
 
 
 class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
@@ -186,21 +192,41 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
                          params + headroom, clamped to what max_slots x
                          max_seq_len requests can actually reach — and the
                          plan publishes ``paddle_hbm_planned_bytes{
-                         component=params|kv_pool|prefix_tier|temps}`` next
+                         component=params|kv_pool|prefix_tier|temps|
+                         adapter_bank}`` next
                          to ``paddle_hbm_budget_bytes``. ValueError when the
                          budget cannot fit even one sequence's blocks.
                          Default None: num_blocks is taken as given.
+    adapters             ISSUE-15: an `inference.adapters.AdapterRegistry`
+                         over THIS model — multi-LoRA serving. Every step
+                         launch grows a traced [S] bank-index input;
+                         `infer(adapter=name)` (HTTP `X-Adapter`) routes a
+                         request through its adapter's low-rank delta while
+                         base requests ride bank slot 0 (identity) of the
+                         SAME program. Load/unload/mix changes never
+                         recompile; admission refcount-pins the slot so an
+                         unload can't race in-flight traffic. Default None:
+                         base model only, step programs keep their exact
+                         pre-adapter signature.
     """
 
     _component = "continuous"
     supports_sampler_knobs = True   # serving.py gates per-request headers
     supports_streaming = True       # tick-boundary flushes -> infer_stream
 
+    @property
+    def supports_adapters(self):
+        """X-Adapter gate (serving.py): routing needs an actual registry —
+        a continuous scheduler without one 400s the header like any
+        whole-batch predictor would."""
+        return getattr(self, "adapters", None) is not None
+
     def __init__(self, model, max_slots=8, prefill_chunk=16,
                  prefill_token_budget=None, decode_steps=4, max_seq_len=None,
                  eos_token_id=None, max_defers=32, spec_k=0, drafter="ngram",
                  admit_policy="fifo", prefix_cache=False, warmup=False,
-                 compile_cache_dir=None, hbm_budget=None, **kwargs):
+                 compile_cache_dir=None, hbm_budget=None, adapters=None,
+                 **kwargs):
         self.max_slots = int(max_slots)
         self.prefill_chunk = int(prefill_chunk)
         self.prefill_token_budget = int(prefill_token_budget
@@ -251,6 +277,10 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
         self._warm_thread = None
         self._recompile_counter = None
         self._slots: list = [None] * self.max_slots
+        # multi-LoRA registry (ISSUE-15): published before super().__init__
+        # starts the tick thread — ticks read it, admission pins slots in it
+        self.adapters = adapters
+        self._lora_requests_counter = None
         # gauges scrape from other threads; witness-wrapped under chaos
         self._slot_lock = make_lock(
             "scheduler.ContinuousGenerateBatchingPredictor._slot_lock")
@@ -273,7 +303,9 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
                 params_bytes=params_bytes_of(model),
                 name=self._component, prefill_chunk=self.prefill_chunk,
                 decode_steps=self.decode_steps, spec_k=self.spec_k,
-                eos_token_id=self.eos_token_id)
+                eos_token_id=self.eos_token_id,
+                adapter_bank_bytes=(0 if adapters is None
+                                    else adapters.bank_bytes()))
             kwargs["num_blocks"] = sizing["num_blocks"]
             self._hbm_plan = sizing["plan"]
         super().__init__(model, max_batch_size=max_slots,
@@ -284,7 +316,8 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
             raise ValueError(f"max_seq_len {self.max_seq_len} exceeds the "
                              f"pool ({pool_tokens} tokens)")
         self.table_width = self.kv_cache.blocks_for(self.max_seq_len)
-        self._spec_counter = self._bind_scheduler_metrics()
+        (self._spec_counter,
+         self._lora_requests_counter) = self._bind_scheduler_metrics()
         if prefix_cache:
             from .prefix_cache import PrefixCache
             pc = (prefix_cache if isinstance(prefix_cache, PrefixCache)
@@ -399,6 +432,26 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
                 "(analysis/hbm.py DeploymentPlan)", labels=("component",))
             for part, nbytes in self._hbm_plan.components().items():
                 planned.labels(part).set(nbytes)
+        # ISSUE-15 multi-LoRA telemetry: bank occupancy by state (loaded =
+        # resident, pinned = refcounted by in-flight slots, free = open
+        # rows) plus per-adapter admission counts. Absent without a
+        # registry — same no-dead-gauges policy as the hbm block above.
+        # Returned (like spec_counter) so the attribute write lands in
+        # __init__, before any worker thread can observe it.
+        lora_counter = None
+        if self.adapters is not None:
+            lora = reg.gauge(
+                "paddle_lora_adapters",
+                "Adapter bank slots by state (loaded|pinned|free); slot 0 "
+                "(base identity) is not counted",
+                labels=("component", "state"))
+            for state in ("loaded", "pinned", "free"):
+                lora.labels(self._component, state).set_function(
+                    lambda st=state: self.adapters.stats()[st])
+            lora_counter = reg.counter(
+                "paddle_lora_requests_total",
+                "Admitted sequences by adapter name ('base' = no adapter)",
+                labels=("component", "adapter"))
         spec_counter = reg.counter(
             "paddle_spec_tokens_total",
             "Speculative decoding tokens by kind: drafted (submitted to "
@@ -409,7 +462,7 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
             "Cumulative speculative acceptance rate (accepted / drafted)",
             labels=("component",)).labels(self._component).set_function(
                 self._acceptance_rate)
-        return spec_counter
+        return spec_counter, lora_counter
 
     def _acceptance_rate(self):
         with self._slot_lock:
@@ -447,7 +500,8 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
 
     # ---------------------------------------------------------------- client
     def infer(self, ids, timeout=None, deadline=None, trace_id=None,
-              max_new_tokens=None, temperature=None, top_k=None, spec=None):
+              max_new_tokens=None, temperature=None, top_k=None, spec=None,
+              adapter=None):
         """One prompt in -> prompt + generated ids out.
 
         `max_new_tokens` (<= the server cap) asks for fewer tokens than the
@@ -465,7 +519,13 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
         (`spec=False`) when the scheduler runs with spec_k > 0: the slot
         rides the same verify program with zero drafts. `spec=True` is a
         no-op beyond the default; it cannot force speculation on a
-        scheduler configured without it."""
+        scheduler configured without it.
+
+        `adapter` (ISSUE-15) names a registered LoRA adapter; the request
+        decodes through its low-rank delta in the SAME tick program as base
+        and other-adapter batchmates. Unknown names (and any adapter on a
+        registry-less scheduler) raise ValueError here, synchronously —
+        HTTP maps it to 400, the X-Temperature taxonomy."""
         req = self._make_request([np.asarray(ids)], timeout, deadline,
                                  trace_id)
         if max_new_tokens is not None:
@@ -477,11 +537,30 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
             req.top_k = int(top_k)
         if spec is not None:
             req.spec = bool(spec)
+        self._route_adapter(req, adapter)
         return self._submit(req)
+
+    def _route_adapter(self, req, adapter):
+        """Validate-and-attach for infer/infer_stream's adapter= param.
+
+        The name is checked NOW (a malformed request must fail before
+        enqueue, 400-style) but resolved to a bank slot at ADMISSION —
+        acquire() there takes the refcount pin for exactly the sequence's
+        lifetime, and an unregister between submit and admit is then an
+        admission failure, never a stale slot index."""
+        if adapter is None:
+            return
+        if self.adapters is None:
+            raise ValueError(
+                "adapter routing needs an AdapterRegistry (scheduler "
+                "adapters= knob); this scheduler serves the base model only")
+        if not self.adapters.has(adapter):
+            raise ValueError(f"unknown adapter {adapter!r}")
+        req.adapter = adapter
 
     def infer_stream(self, ids, timeout=None, deadline=None, trace_id=None,
                      max_new_tokens=None, temperature=None, top_k=None,
-                     spec=None):
+                     spec=None, adapter=None):
         """Streaming twin of infer() (ISSUE-11): tokens arrive as the tick
         loop absorbs them instead of at retirement.
 
@@ -506,6 +585,7 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
             req.top_k = int(top_k)
         if spec is not None:
             req.spec = bool(spec)
+        self._route_adapter(req, adapter)
         q: queue.Queue = queue.Queue()
         req.on_tokens = q.put       # published before enqueue (no races)
         self._start(req)            # raises Rejected/ValueError/503 here
@@ -657,12 +737,32 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
             tr = req.trace
             traced = self.tracer.enabled
             ids64 = np.asarray(arr, np.int64)
+            # ISSUE-15: pin the request's adapter slot FIRST — acquire
+            # bumps the bank-row refcount for exactly the sequence's
+            # lifetime (released in _evict_slot), so an unregister racing
+            # this admission either loses (we hold the pin) or wins (the
+            # name is gone and THIS request fails 400-style; the batch is
+            # untouched). The uid seed keys the prefix lookup below: same
+            # tokens under a different adapter can never share KV.
+            aslot, aseed = 0, b""
+            if self.adapters is not None:
+                aname = getattr(req, "adapter", None)
+                try:
+                    aslot, aseed = self.adapters.acquire(aname)
+                except ThreadDeath:
+                    raise
+                except Exception as e:
+                    self._fail(req, e)
+                    continue
+                self._lora_requests_counter.labels(
+                    self._component,
+                    "base" if aname is None else aname).inc()
             hit, t_px = None, 0.0
             pc = self.prefix_cache
             if pc is not None:
                 t_px = self.tracer.now_us() if traced else 0.0
                 try:
-                    hit = pc.lookup(ids64)   # fault site kv.prefix_match
+                    hit = pc.lookup(ids64, seed=aseed)  # kv.prefix_match
                 except ThreadDeath:
                     raise
                 except Exception as e:
@@ -681,6 +781,8 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
                 if traced and tr is not None:
                     tr.child("kv_reserve", t_kv, self.tracer.now_us(),
                              error=repr(e))
+                if self.adapters is not None:
+                    self.adapters.release(aslot)
                 self._shed_or_defer(req, e)
                 return
             except Exception as e:
@@ -691,6 +793,8 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
                 if traced and tr is not None:
                     tr.child("kv_reserve", t_kv, self.tracer.now_us(),
                              error=repr(e))
+                if self.adapters is not None:
+                    self.adapters.release(aslot)
                 self._fail(req, e)
                 continue
             if traced and tr is not None:
@@ -698,6 +802,8 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
                          blocks=self.kv_cache.blocks_for(plen + max_new))
             self._end_queue_wait([req])
             seq = _SlotSeq(req, rid, ids64, arr.dtype, max_new, seq_n)
+            seq.adapter = aslot
+            seq.adapter_seed = aseed
             seq.table = self.kv_cache.block_table(rid,
                                                   pad_to=self.table_width)
             if hit is not None:
@@ -760,6 +866,11 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
         with self._slot_lock:
             if self._slots[i] is s:
                 self._slots[i] = None
+        if self.adapters is not None and s.adapter != 0:
+            # drop the admission-time bank-slot pin; zeroing first makes a
+            # double-evict (shutdown racing retirement) release exactly once
+            aslot, s.adapter = s.adapter, 0
+            self.adapters.release(aslot)
         try:
             self.kv_cache.mark_done(s.rid)
             self.kv_cache.release(s.rid)
@@ -854,6 +965,25 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
             self._evict_slot(i, s)
             self._fail_or_retry(s.req, error)
 
+    def _adapter_tick_kwargs(self, picks, reqs):
+        """Per-tick LoRA launch kwargs (ISSUE-15): the traced [S] bank-index
+        vector — each live slot gathers its adapter's rows, idle slots ride
+        identity row 0. The host-side assembly is recorded as the
+        `adapter_gather` span with the tick's distinct-adapter count (the
+        heterogeneity dial: 1 means merged-weights would have done)."""
+        if self.adapters is None:
+            return {}
+        traced = self.tracer.enabled
+        t_g = self.tracer.now_us() if traced else 0.0
+        aidx = np.zeros(self.max_slots, np.int32)
+        for i, s in picks:
+            aidx[i] = s.adapter
+        if traced:
+            self._span_each(reqs, "adapter_gather", t_g,
+                            self.tracer.now_us(),
+                            distinct_adapters=len({int(a) for a in aidx}))
+        return dict(adapters=self.adapters, adapter_slots=aidx)
+
     # -------------------------------------------------------------- prefill
     def _prefill_tick(self):
         with self._slot_lock:
@@ -889,6 +1019,7 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
             tks[i] = s.top_k
             tables[i] = s.table
         reqs = [s.req for _, s, _ in picks]
+        akw = self._adapter_tick_kwargs([(i, s) for i, s, _ in picks], reqs)
         traced = self.tracer.enabled
         t0 = self.tracer.now_us() if traced else 0.0
         try:
@@ -899,7 +1030,7 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
                 temperature=temps, top_k=tks,
                 eos_token_id=self.eos_token_id,
                 decode_kernel=self.decode_kernel, seed=next(self._seed),
-                timing_hook=self._gen_timing)
+                timing_hook=self._gen_timing, **akw)
         except ThreadDeath:
             raise
         except Exception as e:
@@ -937,7 +1068,7 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
         try:
             pc.register(s.rid, tokens,
                         digests=s.digests if digests == "prompt" else None,
-                        length=int(committed))
+                        length=int(committed), seed=s.adapter_seed)
         except ThreadDeath:
             raise
         except Exception:       # pragma: no cover - index bug, stay cold
@@ -969,6 +1100,7 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
             tks[i] = s.top_k
             tables[i] = s.table
         reqs = [s.req for _, s in dec]
+        akw = self._adapter_tick_kwargs(dec, reqs)
         traced = self.tracer.enabled
         t0 = self.tracer.now_us() if traced else 0.0
         try:
@@ -979,7 +1111,7 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
                 max_lens=maxlens, temperature=temps, top_k=tks,
                 eos_token_id=self.eos_token_id,
                 decode_kernel=self.decode_kernel, seed=next(self._seed),
-                timing_hook=self._gen_timing)
+                timing_hook=self._gen_timing, **akw)
         except ThreadDeath:
             raise
         except Exception as e:
@@ -1046,6 +1178,7 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
                     chunk[i, 1:1 + n] = prop[:n]
                     dlens[i] = n
         reqs = [s.req for _, s in dec]
+        akw = self._adapter_tick_kwargs(dec, reqs)
         traced = self.tracer.enabled
         t0 = self.tracer.now_us() if traced else 0.0
         try:
@@ -1055,7 +1188,7 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
                 chunk, offs, dlens, active, self.kv_cache, tables,
                 max_lens=maxlens, temperature=temps, top_k=tks,
                 decode_kernel=self.decode_kernel, seed=next(self._seed),
-                timing_hook=self._gen_timing)
+                timing_hook=self._gen_timing, **akw)
         except ThreadDeath:
             raise
         except Exception as e:

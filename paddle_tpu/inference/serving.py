@@ -75,7 +75,7 @@ class _Request:
 
     __slots__ = ("arrays", "event", "result", "error", "deadline", "retries",
                  "defers", "t0", "trace", "enq_us", "max_new", "temperature",
-                 "top_k", "spec", "on_tokens", "_lock", "_state")
+                 "top_k", "spec", "adapter", "on_tokens", "_lock", "_state")
 
     def __init__(self, arrays, deadline=None, trace=None):
         self.arrays = arrays
@@ -92,6 +92,7 @@ class _Request:
         self.temperature = None  # per-request sampling (continuous sched.)
         self.top_k = None
         self.spec = None        # tri-state speculative opt-out (continuous)
+        self.adapter = None     # LoRA adapter name (ISSUE-15, continuous)
         # streaming delivery channel (ISSUE-11): set by infer_stream before
         # enqueue, called by the scheduler's tick loop with each newly
         # absorbed token chunk; None = buffered (non-streaming) request
@@ -155,6 +156,11 @@ class BatchingPredictor:
     # text/event-stream against whole-batch predictors instead of buffering
     # silently (a "stream" that arrives all at once is a lie)
     supports_streaming = False
+
+    # multi-LoRA routing (ISSUE-15) lives in the continuous scheduler's
+    # banked step programs; X-Adapter against a whole-batch predictor is a
+    # client misroute -> 400, same taxonomy as the sampler headers
+    supports_adapters = False
 
     _component = "batcher"      # prometheus `component` label value
 
@@ -886,6 +892,23 @@ class InferenceServer:
                         "scheduler (ContinuousGenerateBatchingPredictor); "
                         "this server's generator batches whole requests "
                         "with a fixed sampler config")
+                # X-Adapter (ISSUE-15): LoRA routing by registry name.
+                # Same strictness as the sampler knobs — an empty name or
+                # an adapter-less generator is a client bug (400), and an
+                # UNKNOWN name 400s from the scheduler's synchronous
+                # validation (never a silent base-model fallback)
+                a = self.headers.get("X-Adapter")
+                if a is not None:
+                    av = a.strip()
+                    if not av:
+                        raise ValueError("malformed X-Adapter (empty name)")
+                    if not getattr(outer.generator,
+                                   "supports_adapters", False):
+                        raise ValueError(
+                            "X-Adapter needs the continuous scheduler with "
+                            "an AdapterRegistry (adapters= knob); this "
+                            "server's generator serves the base model only")
+                    kw["adapter"] = av
                 return kw
 
             def do_GET(self):
@@ -1179,6 +1202,15 @@ class ReplicaFleet:
 
     supports_sampler_knobs = True   # replicas are continuous schedulers
     supports_streaming = True
+
+    @property
+    def supports_adapters(self):
+        """Fleet dispatch is adapter-oblivious (ISSUE-15): every replica
+        shares the ONE AdapterRegistry (build() passes adapters= to all),
+        so X-Adapter routing works iff the replicas carry it — any replica
+        answers for the fleet."""
+        return any(getattr(rep.predictor, "supports_adapters", False)
+                   for rep in self._snapshot())
 
     def __init__(self, replicas, *, admission=None, registry=None,
                  tracer=None, clock=time.monotonic):

@@ -94,6 +94,9 @@ def serving_config_of(predictor) -> ServingConfig:
         kv_signature=tuple(predictor.kv_cache.signature()),
         decode_kernel=predictor.decode_kernel,
         ids_dtype="int64",
+        adapter_signature=(
+            predictor.adapters.signature()
+            if getattr(predictor, "adapters", None) is not None else None),
     )
 
 
@@ -166,20 +169,26 @@ class AOTWarmup:
         tables = np.zeros((S, W), np.int32)
         zeros_i = np.zeros((S,), np.int64)
         idle = np.zeros((S,), bool)
+        # LoRA-enabled predictors warm the BANKED program variant: an
+        # all-slot-0 (identity) index builds the exact program every later
+        # adapter mix reuses — the cache key carries only the bank shape
+        ad = getattr(pred, "adapters", None)
+        akw = ({} if ad is None else
+               dict(adapters=ad, adapter_slots=np.zeros((S,), np.int32)))
         if path == "prefill_chunk":
             args = (np.zeros((S, pred.prefill_chunk), np.int64),
                     zeros_i, zeros_i, kv, tables)
             model.prefill_chunk(*args, eos_token_id=pred.eos_token_id,
-                                decode_kernel=kern, seed=0)
+                                decode_kernel=kern, seed=0, **akw)
         elif path == "decode_step":
             args = (zeros_i, zeros_i, idle, kv, tables)
             model.decode_step(*args, steps=pred.decode_steps,
                               eos_token_id=pred.eos_token_id,
-                              decode_kernel=kern, seed=0)
+                              decode_kernel=kern, seed=0, **akw)
         elif path == "verify_step":
             args = (np.zeros((S, pred.spec_k + 1), np.int64),
                     zeros_i, zeros_i, idle, kv, tables)
-            model.verify_step(*args, decode_kernel=kern, seed=0)
+            model.verify_step(*args, decode_kernel=kern, seed=0, **akw)
         else:
             raise ValueError(f"no warmup launch for path {path!r}")
         return aval_fingerprint(args[:3], None)

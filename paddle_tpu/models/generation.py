@@ -488,10 +488,24 @@ class GenerationMixin:
         except Exception:
             return False
 
+    @staticmethod
+    def _adapter_extra(adapters, adapter_slots, S):
+        """Launch-time LoRA args for the paged step programs: the traced
+        [S] bank index plus the current bank pytree. Empty when no registry
+        rides the call — the base programs keep their exact pre-LoRA
+        signature (and jit cache keys)."""
+        if adapters is None:
+            return ()
+        if adapter_slots is None:
+            aidx = jnp.zeros((S,), jnp.int32)
+        else:
+            aidx = jnp.asarray(adapter_slots, jnp.int32)
+        return (aidx, adapters.bank())
+
     def prefill_chunk(self, chunk_ids, offsets, chunk_lens, kv_cache,
                       block_tables, temperature=0.0, top_k=0,
                       eos_token_id=None, seed=0, decode_kernel="pallas",
-                      timing_hook=None):
+                      adapters=None, adapter_slots=None, timing_hook=None):
         """One chunked-prefill step over the shared paged pool (fixed width).
 
         The continuous scheduler (inference/scheduler.py) splits long prompts
@@ -517,7 +531,13 @@ class GenerationMixin:
 
         `temperature` / `top_k` are scalars or per-slot [S] arrays and enter
         the program as TRACED inputs (see _make_slot_sampler): requests with
-        different sampling params share the one compiled step program."""
+        different sampling params share the one compiled step program.
+
+        `adapters` / `adapter_slots` (ISSUE-15): when an AdapterRegistry
+        rides the call, the per-slot [S] bank index and the bank arrays are
+        ALSO traced inputs — the cache key grows only the bank SHAPE
+        (`adapters.signature()`), so adapter mix changes and load/unload
+        never recompile."""
         ids = (chunk_ids._value if isinstance(chunk_ids, Tensor)
                else jnp.asarray(chunk_ids))
         S, C = ids.shape
@@ -531,12 +551,15 @@ class GenerationMixin:
         tks = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (S,))
         NB = int(block_tables.shape[1])
 
+        # the compile key carries the bank SHAPE only — adapter index and
+        # bank values stay traced, so churn never lands here
+        bank_sig = None if adapters is None else adapters.signature()
+
         def make_run():
             donate = (7, 8) if self._pool_donation() else ()
 
-            @functools.partial(jax.jit, donate_argnums=donate)
-            def run(raw_state, chunk, offs, lens, tables, stemps, stks,
-                    k_pages, v_pages, key):
+            def step(raw_state, chunk, offs, lens, tables, stemps, stks,
+                     k_pages, v_pages, key):
                 offs = offs.astype(jnp.int32)
                 lens = lens.astype(jnp.int32)
                 caches = list(zip(k_pages, v_pages))
@@ -554,10 +577,22 @@ class GenerationMixin:
                 return (tok, [kc for kc, _ in caches],
                         [vc for _, vc in caches])
 
-            return run
+            if bank_sig is None:
+                return jax.jit(step, donate_argnums=donate)
+            from ..inference.adapters import applied
+
+            # aidx/bank slot in AFTER the pools, BEFORE the key: the
+            # donated pool argnums above stay valid either way
+            def lora_run(raw_state, chunk, offs, lens, tables, stemps,
+                         stks, k_pages, v_pages, aidx, bank, key):
+                with applied(bank, aidx):
+                    return step(raw_state, chunk, offs, lens, tables,
+                                stemps, stks, k_pages, v_pages, key)
+
+            return jax.jit(lora_run, donate_argnums=donate)
 
         cache_key = ("prefill_chunk", S, C, NB, kv_cache.signature(), eos,
-                     str(ids_dtype), decode_kernel)
+                     str(ids_dtype), decode_kernel, bank_sig)
         run, compiled_now = self._runner_for(cache_key, make_run)
 
         was_training = self.training
@@ -570,6 +605,7 @@ class GenerationMixin:
                     jnp.asarray(chunk_lens, jnp.int32),
                     jnp.asarray(block_tables, jnp.int32), temps, tks,
                     tuple(kv_cache.k_pages), tuple(kv_cache.v_pages),
+                    *self._adapter_extra(adapters, adapter_slots, S),
                     jax.random.key(seed))
                 kv_cache.commit(new_k, new_v)
             self._emit_timing(timing_hook, "prefill_chunk", S, C, 0,
@@ -582,7 +618,7 @@ class GenerationMixin:
     def decode_step(self, tokens, lengths, active, kv_cache, block_tables,
                     steps=1, max_lens=None, temperature=0.0, top_k=0,
                     eos_token_id=None, seed=0, decode_kernel="pallas",
-                    timing_hook=None):
+                    adapters=None, adapter_slots=None, timing_hook=None):
         """`steps` decode iterations for a fixed-width slot batch (one tick).
 
         The continuous scheduler's steady-state program: S slots, each either
@@ -626,13 +662,13 @@ class GenerationMixin:
         NB = int(block_tables.shape[1])
         if max_lens is None:    # no ceiling: same program, permissive values
             max_lens = jnp.asarray(lengths, jnp.int32) + jnp.int32(T)
+        bank_sig = None if adapters is None else adapters.signature()
 
         def make_run():
             donate = (8, 9) if self._pool_donation() else ()
 
-            @functools.partial(jax.jit, donate_argnums=donate)
-            def run(raw_state, tok, lens, act, lmax, tables, stemps, stks,
-                    k_pages, v_pages, key):
+            def step(raw_state, tok, lens, act, lmax, tables, stemps, stks,
+                     k_pages, v_pages, key):
                 lens = lens.astype(jnp.int32)
                 lmax = lmax.astype(jnp.int32)
                 caches = list(zip(k_pages, v_pages))
@@ -655,10 +691,20 @@ class GenerationMixin:
                 return (jnp.swapaxes(toks, 0, 1),
                         [kc for kc, _ in caches], [vc for _, vc in caches])
 
-            return run
+            if bank_sig is None:
+                return jax.jit(step, donate_argnums=donate)
+            from ..inference.adapters import applied
+
+            def lora_run(raw_state, tok, lens, act, lmax, tables, stemps,
+                         stks, k_pages, v_pages, aidx, bank, key):
+                with applied(bank, aidx):
+                    return step(raw_state, tok, lens, act, lmax, tables,
+                                stemps, stks, k_pages, v_pages, key)
+
+            return jax.jit(lora_run, donate_argnums=donate)
 
         cache_key = ("decode_step", S, T, NB, kv_cache.signature(), eos,
-                     str(ids_dtype), decode_kernel)
+                     str(ids_dtype), decode_kernel, bank_sig)
         run, compiled_now = self._runner_for(cache_key, make_run)
 
         was_training = self.training
@@ -672,6 +718,7 @@ class GenerationMixin:
                     jnp.asarray(max_lens, jnp.int32),
                     jnp.asarray(block_tables, jnp.int32), temps, tks,
                     tuple(kv_cache.k_pages), tuple(kv_cache.v_pages),
+                    *self._adapter_extra(adapters, adapter_slots, S),
                     jax.random.key(seed))
                 kv_cache.commit(new_k, new_v)
             self._emit_timing(timing_hook, "decode_step", S, 1, T,
@@ -683,7 +730,8 @@ class GenerationMixin:
 
     def verify_step(self, chunk_ids, offsets, draft_lens, active, kv_cache,
                     block_tables, max_lens=None, temperature=0.0, top_k=0,
-                    seed=0, decode_kernel="pallas", timing_hook=None):
+                    seed=0, decode_kernel="pallas", adapters=None,
+                    adapter_slots=None, timing_hook=None):
         """Speculative draft verification over the paged pool (fixed width).
 
         One launch scores K drafted tokens per slot in a SINGLE forward
@@ -744,13 +792,13 @@ class GenerationMixin:
         NB = int(block_tables.shape[1])
         if max_lens is None:    # no ceiling: same program, permissive values
             max_lens = jnp.asarray(offsets, jnp.int32) + jnp.int32(W)
+        bank_sig = None if adapters is None else adapters.signature()
 
         def make_run():
             donate = (9, 10) if self._pool_donation() else ()
 
-            @functools.partial(jax.jit, donate_argnums=donate)
-            def run(raw_state, chunk, offs, dlens, act, lmax, tables,
-                    stemps, stks, k_pages, v_pages, key):
+            def step(raw_state, chunk, offs, dlens, act, lmax, tables,
+                     stemps, stks, k_pages, v_pages, key):
                 offs = offs.astype(jnp.int32)
                 dlens = dlens.astype(jnp.int32)
                 lmax = lmax.astype(jnp.int32)
@@ -818,10 +866,21 @@ class GenerationMixin:
                 return (accepted, nxt, [kc for kc, _ in caches],
                         [vc for _, vc in caches])
 
-            return run
+            if bank_sig is None:
+                return jax.jit(step, donate_argnums=donate)
+            from ..inference.adapters import applied
+
+            def lora_run(raw_state, chunk, offs, dlens, act, lmax, tables,
+                         stemps, stks, k_pages, v_pages, aidx, bank, key):
+                with applied(bank, aidx):
+                    return step(raw_state, chunk, offs, dlens, act, lmax,
+                                tables, stemps, stks, k_pages, v_pages,
+                                key)
+
+            return jax.jit(lora_run, donate_argnums=donate)
 
         cache_key = ("verify_step", S, W, NB, kv_cache.signature(),
-                     str(ids_dtype), decode_kernel)
+                     str(ids_dtype), decode_kernel, bank_sig)
         run, compiled_now = self._runner_for(cache_key, make_run)
 
         was_training = self.training
@@ -836,6 +895,7 @@ class GenerationMixin:
                     jnp.asarray(max_lens, jnp.int32),
                     jnp.asarray(block_tables, jnp.int32), temps, tks,
                     tuple(kv_cache.k_pages), tuple(kv_cache.v_pages),
+                    *self._adapter_extra(adapters, adapter_slots, S),
                     jax.random.key(seed))
                 kv_cache.commit(new_k, new_v)
             self._emit_timing(timing_hook, "verify_step", S, W, 1,
@@ -863,31 +923,39 @@ class GenerationMixin:
             eos_token_id=eos_token_id, seed=seed, dtype=dtype,
             decode_kernel=decode_kernel, kv_cache=kv_cache, stats=stats)
 
-    def compiled_prefill_chunk_runner(self, slots, chunk):
+    def compiled_prefill_chunk_runner(self, slots, chunk,
+                                      adapter_signature=None):
         """The cached compiled prefill-chunk program
         (state, chunk, offsets, lens, tables, k_pages, v_pages, key) -> tok
         for a prior prefill_chunk() shape, or None (zoo lint + bench audit
-        hook, the chunked twin of compiled_generate_paged_runner)."""
+        hook, the chunked twin of compiled_generate_paged_runner).
+        `adapter_signature` selects the LoRA variant (bank-shape key);
+        None matches the base program."""
         for k, run in (getattr(self, "_generate_cache", None) or {}).items():
-            if k[:3] == ("prefill_chunk", slots, chunk):
+            if (k[:3] == ("prefill_chunk", slots, chunk)
+                    and k[-1] == adapter_signature):
                 return run
         return None
 
-    def compiled_decode_step_runner(self, slots, steps):
+    def compiled_decode_step_runner(self, slots, steps,
+                                    adapter_signature=None):
         """The cached compiled decode-step program
         (state, tok, lens, active, tables, k_pages, v_pages, key) -> toks
         for a prior decode_step() shape, or None."""
         for k, run in (getattr(self, "_generate_cache", None) or {}).items():
-            if k[:3] == ("decode_step", slots, steps):
+            if (k[:3] == ("decode_step", slots, steps)
+                    and k[-1] == adapter_signature):
                 return run
         return None
 
-    def compiled_verify_step_runner(self, slots, width):
+    def compiled_verify_step_runner(self, slots, width,
+                                    adapter_signature=None):
         """The cached compiled speculative verify program (state, chunk,
         offsets, draft_lens, active, max_lens, tables, temps, top_ks,
         k_pages, v_pages, key) -> (accepted, next) for a prior
         verify_step() shape, or None. `width` is the chunk width K+1."""
         for k, run in (getattr(self, "_generate_cache", None) or {}).items():
-            if k[:3] == ("verify_step", slots, width):
+            if (k[:3] == ("verify_step", slots, width)
+                    and k[-1] == adapter_signature):
                 return run
         return None

@@ -12,30 +12,36 @@ Never imported; consumed as SOURCE by the AST pass.
 
 class _StepModel:
     def prefill_chunk(self, chunk_ids, offsets, chunk_lens, kv_cache,
-                      block_tables, eos_token_id=None, decode_kernel=None):
+                      block_tables, eos_token_id=None, decode_kernel=None,
+                      adapters=None, adapter_slots=None):
         S, C = chunk_ids.shape
         W = block_tables.shape[1]
         eos = -1 if eos_token_id is None else int(eos_token_id)
+        bank_sig = None if adapters is None else adapters.signature()
         cache_key = ("prefill_chunk", S, C, W, kv_cache.signature(), eos,
-                     str(chunk_ids.dtype), decode_kernel)
+                     str(chunk_ids.dtype), decode_kernel, bank_sig)
         run = self._runner_for(cache_key, lambda: None)
         return run(chunk_ids)
 
     def decode_step(self, tokens, lengths, active, kv_cache, block_tables,
-                    steps=1, eos_token_id=None, decode_kernel=None):
+                    steps=1, eos_token_id=None, decode_kernel=None,
+                    adapters=None, adapter_slots=None):
         S = tokens.shape[0]
         W = block_tables.shape[1]
         eos = -1 if eos_token_id is None else int(eos_token_id)
+        bank_sig = None if adapters is None else adapters.signature()
         cache_key = ("decode_step", S, int(steps), W, kv_cache.signature(),
-                     eos, str(tokens.dtype), decode_kernel)
+                     eos, str(tokens.dtype), decode_kernel, bank_sig)
         run = self._runner_for(cache_key, lambda: None)
         return run(tokens)
 
     def verify_step(self, chunk_ids, offsets, draft_lens, active, kv_cache,
-                    block_tables, decode_kernel=None):
+                    block_tables, decode_kernel=None, adapters=None,
+                    adapter_slots=None):
         S, K1 = chunk_ids.shape
         W = block_tables.shape[1]
+        bank_sig = None if adapters is None else adapters.signature()
         cache_key = ("verify_step", S, K1, W, kv_cache.signature(),
-                     str(chunk_ids.dtype), decode_kernel)
+                     str(chunk_ids.dtype), decode_kernel, bank_sig)
         run = self._runner_for(cache_key, lambda: None)
         return run(chunk_ids)

@@ -71,8 +71,9 @@ def test_real_tree_is_clean_with_visible_paged_suppressions():
 
 def test_default_manifest_is_closed_over_default_configs(schemas):
     manifest = cs.default_manifest()
-    # default + spec configs share prefill/decode keys; spec adds verify
-    assert len(manifest.programs) == 3
+    # default + spec configs share prefill/decode keys; spec adds verify;
+    # the lora config forks banked prefill/decode variants (ISSUE-15)
+    assert len(manifest.programs) == 5
     for cfg in cs.default_serving_configs():
         for key in cfg.program_keys(schemas):
             assert manifest.covers(key)
@@ -121,10 +122,13 @@ def test_cli_manifest_prints_derived_inventory(capsys):
     assert cli_main(["--manifest"]) == 0
     payload = json.loads(capsys.readouterr().out)
     assert [c["name"] for c in payload["configs"]] == [
-        "continuous-default", "continuous-spec"]
-    assert len(payload["manifest"]["programs"]) == 3
+        "continuous-default", "continuous-spec", "continuous-lora"]
+    assert len(payload["manifest"]["programs"]) == 5
     spec_paths = [k[0] for k in payload["programs"]["continuous-spec"]]
     assert spec_paths == ["prefill_chunk", "decode_step", "verify_step"]
+    lora_keys = payload["programs"]["continuous-lora"]
+    assert [k[0] for k in lora_keys] == ["prefill_chunk", "decode_step"]
+    assert all(k[-1] == ["lora", 5, 8, 4] for k in lora_keys)
     assert cli_main(["--manifest", "no-such-config"]) == 2
     capsys.readouterr()
 
@@ -136,7 +140,7 @@ def test_zoo_cross_check_and_registry_cover_every_path():
     assert set(fam) == {"dense", "paged", "prefill_chunk", "decode_step",
                         "verify_step"}
     assert "compile_surface" in ZOO_PROGRAMS
-    assert len(ZOO_PROGRAMS) == 12
+    assert len(ZOO_PROGRAMS) == 16
 
 
 def test_shared_aval_fingerprint_backs_both_sentinels():

@@ -31,6 +31,9 @@ def _greedy_reference(model, ids, n):
     return ids
 
 
+@pytest.mark.slow   # ~13s: slow-marked in PR 15 (tier-1 budget rule) —
+# decode parity stays tier-1-anchored by test_decode_attention's
+# pallas-vs-xla token parity and the continuous-serving dense references
 @pytest.mark.parametrize("kwargs", [
     dict(),                                     # rope + rmsnorm + swiglu
     dict(use_rope=False, use_rms_norm=False, use_swiglu=False),  # gpt2-style

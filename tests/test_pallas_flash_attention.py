@@ -132,6 +132,8 @@ def test_supports_gate():
     assert not pfa.supports((2, 256, 4, 64), (2, 128, 4, 64))  # cross-attention
 
 
+@pytest.mark.slow   # ~16s: slow-marked in PR 15 (tier-1 budget rule) — the
+# smaller-S flash_grad_parity legs keep the backward-parity canary tier-1
 def test_chunked_backward_matches_reference_s8192():
     """S>4096 routes the backward through the chunk-accumulating kernels
     (VMEM-safe at any S); gradients must match the dense reference."""

@@ -425,3 +425,55 @@ def test_hbm_planning_bench_wires_plan_and_fields():
     assert "analyze_hbm_plan(" in src
     assert "hbm_planning_fields(" in src
     assert "planned_total_bytes" in src
+
+
+# ------------------------------------------------------------ ISSUE-15 lora
+def test_multi_lora_fields_speedup_gate_and_audit():
+    """ISSUE-15 acceptance wiring: the multi_lora section derives
+    `speedup_batched_over_sequential` from the two walls (gated >= 2.0 —
+    four adapters sharing ticks vs per-adapter draining), and the audit
+    folds slot-0 parity and the zero-recompile churn invariant ahead of
+    the speedup gate."""
+    out = {"batched_s": 0.05, "sequential_s": 0.13,
+           "program_cache_growth": 0, "slot0_parity": "ok"}
+    bench.multi_lora_fields(out)
+    assert out["speedup_batched_over_sequential"] == pytest.approx(2.6)
+    assert out["audit"] == "ok"
+
+
+def test_multi_lora_fields_flag_each_gate():
+    base = {"batched_s": 0.05, "sequential_s": 0.13,
+            "program_cache_growth": 0, "slot0_parity": "ok"}
+    out = dict(base, slot0_parity="mismatch")
+    bench.multi_lora_fields(out)
+    assert out["audit"] == "slot0-parity-mismatch"   # parity beats the rest
+    out = dict(base, program_cache_growth=2)
+    bench.multi_lora_fields(out)
+    assert out["audit"] == "recompiled-on-churn"
+    out = dict(base, sequential_s=0.08)
+    bench.multi_lora_fields(out)
+    assert out["speedup_batched_over_sequential"] == pytest.approx(1.6)
+    assert out["audit"] == "no-batching-win"
+
+
+def test_multi_lora_fields_skip_missing_sections():
+    out = {"batched_s": 0.05}                    # sequential leg absent
+    bench.multi_lora_fields(out)
+    assert "speedup_batched_over_sequential" not in out
+    assert "audit" not in out
+
+
+def test_multi_lora_bench_wires_churn_parity_and_fields():
+    """Source-level pin: bench_multi_lora must drive heterogeneous-adapter
+    ticks (concurrent per-adapter clients), churn the registry mid-serving
+    while watching the runner cache, compare slot-0 traffic against a
+    registry-free scheduler, and route through multi_lora_fields — the
+    full leg compiles step programs, too heavy for this unit file."""
+    import inspect
+
+    src = inspect.getsource(bench.bench_multi_lora)
+    assert "multi_lora_fields(" in src
+    assert "AdapterRegistry(" in src
+    assert "unregister(" in src and "register(" in src
+    assert "_runner_cache()" in src
+    assert "slot0_parity" in src
