@@ -1046,6 +1046,126 @@ def multi_lora_fields(out):
     return out
 
 
+def bench_tenant_fairness(on_accel, dev):
+    """Multi-tenant fair share under overload (ISSUE-17 acceptance).
+
+    Three weighted tenants (gold w3, silver w2, bronze w1, equal priority)
+    plus one flash-crowd aggressor (w1, 4x the client concurrency of any
+    weighted tenant) hammer a 4-slot scheduler closed-loop for a fixed
+    window — sustained demand is ~7 in-flight requests per slot, >= the 4x
+    overload the gate calls for. Every client resubmits as soon as its
+    previous request retires, so observed per-tenant throughput is the
+    SCHEDULER's allocation (weighted fair-share admission), not the
+    traffic mix: without the ledger the aggressor's 16 clients would take
+    ~16/28 of the slots; with it every tenant converges to weight/sum.
+
+    Gate (tenant_fairness_fields): every tenant's delivered share of
+    useful tok/s >= 90% of its weight share."""
+    import threading
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.qos import TenantLedger
+    from paddle_tpu.inference.scheduler import (
+        ContinuousGenerateBatchingPredictor,
+    )
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                    num_heads=4, max_position=128)
+    kern = "pallas" if on_accel else "xla"
+    P, NEW, SLOTS, WINDOW_S = 8, 16, 4, 6.0
+    WEIGHTS = {"gold": 3.0, "silver": 2.0, "bronze": 1.0, "flash": 1.0}
+    CLIENTS = {"gold": 4, "silver": 4, "bronze": 4, "flash": 16}
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    ledger = TenantLedger()
+    for name, w in WEIGHTS.items():
+        ledger.register(name, weight=w, priority=1)
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(0, cfg.vocab_size, P).astype(np.int64)
+
+    sched = ContinuousGenerateBatchingPredictor(
+        model, max_slots=SLOTS, prefill_chunk=P, decode_steps=4,
+        max_new_tokens=NEW, decode_kernel=kern, block_size=8,
+        num_blocks=64, max_seq_len=P + NEW, qos=ledger)
+    stop = threading.Event()
+
+    def client(tenant):
+        while not stop.is_set():
+            try:
+                sched.infer(prompt, timeout=600, max_new_tokens=NEW,
+                            tenant=tenant)
+            except Exception:
+                return      # bench bookkeeping: a shed client just exits
+
+    try:
+        # compile the step programs once, untimed
+        sched.infer(prompt, timeout=600, max_new_tokens=NEW)
+        base = {n: s["tokens_done"]
+                for n, s in ledger.snapshot().items() if n in WEIGHTS}
+        ts = [threading.Thread(target=client, args=(name,))
+              for name, k in CLIENTS.items() for _ in range(k)]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        time.sleep(WINDOW_S)
+        stop.set()
+        for t in ts:
+            t.join(timeout=600)
+        window_s = time.perf_counter() - t0
+        snap = ledger.snapshot()
+        metrics = dict(sched.metrics.snapshot())
+    finally:
+        stop.set()
+        sched.close()
+
+    out = dict(metrics)
+    out.update(
+        slots=SLOTS, prompt_tokens=P, new_tokens=NEW,
+        window_s=round(window_s, 3),
+        clients={n: int(k) for n, k in CLIENTS.items()},
+        overload_clients_per_slot=round(sum(CLIENTS.values()) / SLOTS, 2),
+        tenants={n: {"weight": WEIGHTS[n],
+                     "tokens_done": int(snap[n]["tokens_done"] - base[n]),
+                     "admitted": int(snap[n]["admitted"])}
+                 for n in WEIGHTS},
+    )
+    tenant_fairness_fields(out)
+    return out, None
+
+
+def tenant_fairness_fields(out):
+    """Gate fields for the tenant_fairness section: from per-tenant
+    {weight, tokens_done} compute each tenant's delivered share of useful
+    tokens vs its weight share (weight / sum-of-weights), the fleet-wide
+    useful tok/s, and the audit — "ok" iff EVERY tenant's delivered/fair
+    ratio >= 0.9 (the ISSUE-17 starvation gate), else "starved:<tenant>"
+    naming the worst victim. Pure function of the measured dict so tests
+    pin the math on synthetic inputs."""
+    tenants = out.get("tenants")
+    if not tenants:
+        return out
+    total_w = sum(t["weight"] for t in tenants.values())
+    total_tok = sum(t["tokens_done"] for t in tenants.values())
+    if not total_w or not total_tok:
+        return out
+    worst_name, worst = None, None
+    for name, t in sorted(tenants.items()):
+        fair = t["weight"] / total_w
+        got = t["tokens_done"] / total_tok
+        t["fair_share"] = round(fair, 4)
+        t["delivered_share"] = round(got, 4)
+        t["fair_share_ratio"] = round(got / fair, 4)
+        if worst is None or t["fair_share_ratio"] < worst:
+            worst_name, worst = name, t["fair_share_ratio"]
+    out["min_fair_share_ratio"] = worst
+    if "window_s" in out:
+        out["useful_tokens_per_sec"] = round(total_tok / out["window_s"], 2)
+    out["audit"] = "ok" if worst >= 0.9 else f"starved:{worst_name}"
+    return out
+
+
 def bench_observability_overhead(on_accel, dev):
     """Instrumentation-cost leg (ISSUE-3): the serving-pressure workload run
     on ONE model with the observability layer enabled (request tracing +
@@ -1885,6 +2005,15 @@ def main():
     except Exception:
         pass
     try:
+        tenant_fair, tenant_fair_err = bench_tenant_fairness(on_accel, dev)
+    except Exception as e:
+        tenant_fair, tenant_fair_err = None, {"error": repr(e)[:200]}
+    gc.collect()
+    try:
+        jax.clear_caches()
+    except Exception:
+        pass
+    try:
         obs, obs_err = bench_observability_overhead(on_accel, dev)
     except Exception as e:
         obs, obs_err = None, {"error": repr(e)[:200]}
@@ -1986,6 +2115,8 @@ def main():
             "prefix_caching": prefix if prefix is not None else prefix_err,
             "multi_lora": (multi_lora if multi_lora is not None
                            else multi_lora_err),
+            "tenant_fairness": (tenant_fair if tenant_fair is not None
+                                else tenant_fair_err),
             "observability_overhead": obs if obs is not None else obs_err,
             "train_observability_overhead": (train_obs if train_obs is not None
                                              else train_obs_err),

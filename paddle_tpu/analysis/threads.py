@@ -79,6 +79,7 @@ RUNTIME_MODULES = (
     "inference/kv_cache.py",
     "inference/prefix_cache.py",
     "inference/adapters.py",
+    "inference/qos.py",
     "inference/resilience.py",
     "inference/faults.py",
     "framework/checkpoint.py",

@@ -134,3 +134,9 @@ from .speculative import (  # noqa: E402,F401  (draft/verify decoding)
     make_drafter,
     speculative_generate,
 )
+
+from .qos import (  # noqa: E402,F401  (multi-tenant QoS + fleet autoscaling)
+    FleetAutoscaler,
+    TenantLedger,
+    TenantSpec,
+)

@@ -34,6 +34,16 @@ site                   where it is checked
                        before any bank mutation — an injected error models
                        a corrupt adapter artifact; in-flight traffic and
                        already-loaded adapters must be untouched
+``qos.ledger``         entry of ``TenantLedger.charge`` (ISSUE-17) — an
+                       injected error degrades the tenant rate limit to
+                       ADMIT-ALL (counted in ``paddle_qos_ledger_degraded_
+                       total``); a broken ledger must never wedge or fail
+                       admission
+``fleet.scale_up``     inside ``FleetAutoscaler._scale_up`` (ISSUE-17),
+                       before ``ReplicaFleet.add_replica`` — an injected
+                       error models a failed replica provision; the fleet
+                       keeps serving on the survivors and the scale event
+                       counts ``error``
 =====================  =====================================================
 
 Training-side sites (``framework/checkpoint.py`` — pass ``injector=`` to the

@@ -77,7 +77,8 @@ class _SlotSeq:
     __slots__ = ("req", "rid", "ids", "out_dtype", "plen", "pos", "tok",
                  "length", "generated", "table", "phase", "max_new", "order",
                  "temperature", "top_k", "spec", "prefix_hit", "digests",
-                 "flushed", "adapter", "adapter_seed")
+                 "flushed", "adapter", "adapter_seed", "tenant", "priority",
+                 "qos_held")
 
     def __init__(self, req, rid, ids, out_dtype, max_new, order):
         self.req = req
@@ -114,6 +115,13 @@ class _SlotSeq:
         # uid, which seeds the prefix-cache digest chain (KV isolation)
         self.adapter = 0
         self.adapter_seed = b""
+        # multi-tenant QoS (ISSUE-17): resolved tenant name + priority tier
+        # (lower = more urgent), and whether this sequence currently holds
+        # its tenant's fair-share inflight count (pause releases it while
+        # the blocks stay reserved)
+        self.tenant = None
+        self.priority = 0
+        self.qos_held = False
 
 
 class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
@@ -208,6 +216,20 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
                          unload can't race in-flight traffic. Default None:
                          base model only, step programs keep their exact
                          pre-adapter signature.
+    qos                  ISSUE-17: an `inference.qos.TenantLedger` — multi-
+                         tenant weighted fair-share admission, per-tenant
+                         token-budget rate limits (429 + computed
+                         Retry-After at the admission door) and priority
+                         preemption: a strictly more urgent waiting request
+                         PAUSES the least urgent running sequence (blocks
+                         retained, slot state parked, tick width freed) and
+                         the paused sequence resumes bit-exactly later
+                         through the same continuation bookkeeping a
+                         prefix-cache hit uses. Pause/resume and tenant mix
+                         are host-side only: ZERO new compiled programs.
+                         Share ONE ledger across a fleet's replicas for
+                         global buckets. Default None: untenanted traffic,
+                         admission exactly as before.
     """
 
     _component = "continuous"
@@ -221,12 +243,18 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
         whole-batch predictor would."""
         return getattr(self, "adapters", None) is not None
 
+    @property
+    def supports_tenants(self):
+        """X-Tenant gate (serving.py): tenant routing needs a TenantLedger
+        (qos= knob) — same strict 400 taxonomy as X-Adapter."""
+        return getattr(self, "qos", None) is not None
+
     def __init__(self, model, max_slots=8, prefill_chunk=16,
                  prefill_token_budget=None, decode_steps=4, max_seq_len=None,
                  eos_token_id=None, max_defers=32, spec_k=0, drafter="ngram",
                  admit_policy="fifo", prefix_cache=False, warmup=False,
                  compile_cache_dir=None, hbm_budget=None, adapters=None,
-                 **kwargs):
+                 qos=None, **kwargs):
         self.max_slots = int(max_slots)
         self.prefill_chunk = int(prefill_chunk)
         self.prefill_token_budget = int(prefill_token_budget
@@ -281,6 +309,12 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
         # starts the tick thread — ticks read it, admission pins slots in it
         self.adapters = adapters
         self._lora_requests_counter = None
+        # multi-tenant QoS ledger (ISSUE-17): published before the tick
+        # thread starts; _qos_admit reads it. Paused (preempted) sequences
+        # park in a deque (documented-atomic type): appended/removed by the
+        # batcher thread, scraped by gauges and pending() from others.
+        self.qos = qos
+        self._paused: collections.deque = collections.deque()
         # gauges scrape from other threads; witness-wrapped under chaos
         self._slot_lock = make_lock(
             "scheduler.ContinuousGenerateBatchingPredictor._slot_lock")
@@ -452,6 +486,27 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
                 "paddle_lora_requests_total",
                 "Admitted sequences by adapter name ('base' = no adapter)",
                 labels=("component", "adapter"))
+        # ISSUE-17 multi-tenant QoS telemetry: the ledger's tenant series
+        # (requests/tokens/rate-limited/inflight — bound ONCE per registry,
+        # fleet replicas sharing a ledger are no-ops) plus this scheduler's
+        # own paused-width gauge and per-tenant backlog (scrape-time queue
+        # scan: no incremental counters to drift across defer/requeue).
+        if self.qos is not None:
+            self.qos.bind_metrics(reg)
+            reg.gauge(
+                "paddle_sched_paused",
+                "Preempted sequences parked off-slot (blocks retained; "
+                "resumed through the prefix-hit continuation path)",
+                labels=("component",)).labels(self._component).set_function(
+                    lambda: float(len(self._paused)))
+            backlog = reg.gauge(
+                "paddle_tenant_backlog",
+                "Queued (not yet slotted) requests by tenant on this "
+                "scheduler (autoscaler pressure signal)",
+                labels=("component", "tenant"))
+            for name in self.qos.tenant_names():
+                backlog.labels(self._component, name).set_function(
+                    lambda n=name: float(self.tenant_backlog().get(n, 0)))
         spec_counter = reg.counter(
             "paddle_spec_tokens_total",
             "Speculative decoding tokens by kind: drafted (submitted to "
@@ -501,7 +556,7 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
     # ---------------------------------------------------------------- client
     def infer(self, ids, timeout=None, deadline=None, trace_id=None,
               max_new_tokens=None, temperature=None, top_k=None, spec=None,
-              adapter=None):
+              adapter=None, tenant=None):
         """One prompt in -> prompt + generated ids out.
 
         `max_new_tokens` (<= the server cap) asks for fewer tokens than the
@@ -525,7 +580,14 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
         decodes through its low-rank delta in the SAME tick program as base
         and other-adapter batchmates. Unknown names (and any adapter on a
         registry-less scheduler) raise ValueError here, synchronously —
-        HTTP maps it to 400, the X-Temperature taxonomy."""
+        HTTP maps it to 400, the X-Temperature taxonomy.
+
+        `tenant` (ISSUE-17) bills the request to a TenantLedger tenant:
+        weighted fair-share admission, the tenant's token-budget rate
+        limit at the door (429 + computed Retry-After), and its priority
+        tier for preemption. Unknown names (and any tenant on a
+        ledger-less scheduler) raise ValueError — the X-Adapter taxonomy;
+        None rides the ledger's built-in default tenant."""
         req = self._make_request([np.asarray(ids)], timeout, deadline,
                                  trace_id)
         if max_new_tokens is not None:
@@ -538,6 +600,7 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
         if spec is not None:
             req.spec = bool(spec)
         self._route_adapter(req, adapter)
+        self._route_tenant(req, tenant)
         return self._submit(req)
 
     def _route_adapter(self, req, adapter):
@@ -558,9 +621,24 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
             raise ValueError(f"unknown adapter {adapter!r}")
         req.adapter = adapter
 
+    def _route_tenant(self, req, tenant):
+        """Validate-and-attach for infer/infer_stream's tenant= param:
+        unknown names fail NOW (400-style, before enqueue), None resolves
+        to the ledger's default tenant, and a tenant on a ledger-less
+        scheduler is a client misroute (same contract as _route_adapter)."""
+        if tenant is None:
+            if self.qos is not None:
+                req.tenant = self.qos.resolve(None).name
+            return
+        if self.qos is None:
+            raise ValueError(
+                "tenant routing needs a TenantLedger (scheduler qos= "
+                "knob); this scheduler serves untenanted traffic only")
+        req.tenant = self.qos.resolve(tenant).name  # ValueError: unknown
+
     def infer_stream(self, ids, timeout=None, deadline=None, trace_id=None,
                      max_new_tokens=None, temperature=None, top_k=None,
-                     spec=None, adapter=None):
+                     spec=None, adapter=None, tenant=None):
         """Streaming twin of infer() (ISSUE-11): tokens arrive as the tick
         loop absorbs them instead of at retirement.
 
@@ -586,6 +664,7 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
         if spec is not None:
             req.spec = bool(spec)
         self._route_adapter(req, adapter)
+        self._route_tenant(req, tenant)
         q: queue.Queue = queue.Queue()
         req.on_tokens = q.put       # published before enqueue (no races)
         self._start(req)            # raises Rejected/ValueError/503 here
@@ -646,7 +725,7 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
         finally:
             req.on_tokens = None
 
-    def _admission_check(self, arrays):
+    def _admission_check(self, arrays, req=None):
         plen = len(arrays[0])
         total = plen + self.max_new_tokens
         if total > self.max_seq_len:
@@ -657,11 +736,44 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
         need = self.kv_cache.blocks_for(total)
         self.admission.admit(self._queue.qsize(), cache=self.kv_cache,
                              blocks_needed=need)
+        if self.qos is not None and req is not None:
+            # tenant token-budget rate limit (ISSUE-17): charged at the
+            # door with the request's worst-case token bill; a shed raises
+            # ServerBusy carrying the bucket's computed time-to-refill —
+            # HTTP 429 with a Retry-After derived from the tenant's rate
+            want = (req.max_new if req.max_new is not None
+                    else self.max_new_tokens)
+            self.qos.charge(getattr(req, "tenant", None), plen + want)
 
     def pending(self) -> int:
-        """Queued + in-flight sequences (drain condition)."""
+        """Queued + in-flight + paused sequences (drain condition)."""
         return (self._queue.qsize() + len(self._backlog)
-                + self._phase_count(None))
+                + len(self._paused) + self._phase_count(None))
+
+    def tenant_backlog(self) -> dict:
+        """Queued (not yet slotted) PENDING requests by tenant: a
+        scrape-time scan of the arrival queue + reorder backlog, so there
+        is no incremental counter to drift across defer/retry/requeue
+        paths. Feeds the paddle_tenant_backlog gauge and the autoscaler's
+        per-tenant pressure signal."""
+        if self.qos is None:
+            return {}
+        counts: dict = {}
+        for r in list(self._queue.queue) + list(self._backlog):
+            if r.state != _PENDING:
+                continue
+            name = self._tenant_spec_of(r).name
+            counts[name] = counts.get(name, 0) + 1
+        return counts
+
+    def _tenant_spec_of(self, req):
+        """Request -> TenantSpec; anything unroutable rides the default
+        tenant (routing already 400'd truly unknown names — this is the
+        tick loop, which must never fail on a stray request field)."""
+        try:
+            return self.qos.resolve(getattr(req, "tenant", None))
+        except ValueError:
+            return self.qos.resolve(None)
 
     # ------------------------------------------------------------- tick loop
     def _loop(self):
@@ -715,7 +827,15 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
         slot or the pool is untouched. On a dry pool the request defers or
         sheds (existing `_shed_or_defer` budget) and admission STOPS for
         this tick — blocks free as other slots retire, so later ticks
-        retry; already-running slots never notice."""
+        retry; already-running slots never notice.
+
+        With a TenantLedger (qos= knob) admission routes through
+        `_qos_admit` instead: free slots go to the most under-served
+        tenant's waiting work (paused sequences compete with new arrivals),
+        then strictly more urgent waiters preempt the least urgent running
+        sequences."""
+        if self.qos is not None:
+            return self._qos_admit()
         block = self._phase_count(None) == 0    # idle: park, don't spin
         while True:
             idx = self._free_slot()
@@ -728,105 +848,275 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
             block = False
             if not self._usable(req):
                 continue
-            arr = req.arrays[0]
-            plen = len(arr)
-            max_new = (req.max_new if req.max_new is not None
-                       else self.max_new_tokens)
-            seq_n = next(self._rid)     # atomic draw (itertools.count)
-            rid = ("cseq", seq_n)
-            tr = req.trace
-            traced = self.tracer.enabled
-            ids64 = np.asarray(arr, np.int64)
-            # ISSUE-15: pin the request's adapter slot FIRST — acquire
-            # bumps the bank-row refcount for exactly the sequence's
-            # lifetime (released in _evict_slot), so an unregister racing
-            # this admission either loses (we hold the pin) or wins (the
-            # name is gone and THIS request fails 400-style; the batch is
-            # untouched). The uid seed keys the prefix lookup below: same
-            # tokens under a different adapter can never share KV.
-            aslot, aseed = 0, b""
-            if self.adapters is not None:
-                aname = getattr(req, "adapter", None)
-                try:
-                    aslot, aseed = self.adapters.acquire(aname)
-                except ThreadDeath:
-                    raise
-                except Exception as e:
-                    self._fail(req, e)
-                    continue
-                self._lora_requests_counter.labels(
-                    self._component,
-                    "base" if aname is None else aname).inc()
-            hit, t_px = None, 0.0
-            pc = self.prefix_cache
-            if pc is not None:
-                t_px = self.tracer.now_us() if traced else 0.0
-                try:
-                    hit = pc.lookup(ids64, seed=aseed)  # kv.prefix_match
-                except ThreadDeath:
-                    raise
-                except Exception as e:
-                    # a broken index lookup is a cache MISS, never a failed
-                    # request — the cold path below is always correct
-                    if traced and tr is not None:
-                        tr.child("prefix_lookup", t_px, self.tracer.now_us(),
-                                 error=repr(e))
-                    hit = None
-            t_kv = self.tracer.now_us() if traced else 0.0
-            try:
-                self.kv_cache.reserve(
-                    rid, plen + max_new,
-                    shared=hit.pairs if hit is not None else None)
-            except CacheOutOfBlocks as e:
-                if traced and tr is not None:
-                    tr.child("kv_reserve", t_kv, self.tracer.now_us(),
-                             error=repr(e))
-                if self.adapters is not None:
-                    self.adapters.release(aslot)
-                self._shed_or_defer(req, e)
+            if not self._install_seq(idx, req):
                 return
+
+    def _install_seq(self, idx, req) -> bool:
+        """Admit ONE usable request into free slot `idx`: pin its adapter,
+        consult the prefix cache, atomically reserve its blocks, and place
+        the sequence. Returns False only on a dry pool (CacheOutOfBlocks →
+        `_shed_or_defer`; the caller stops admitting this tick); every
+        other failure is THIS request's terminal and admission continues."""
+        arr = req.arrays[0]
+        plen = len(arr)
+        max_new = (req.max_new if req.max_new is not None
+                   else self.max_new_tokens)
+        seq_n = next(self._rid)     # atomic draw (itertools.count)
+        rid = ("cseq", seq_n)
+        tr = req.trace
+        traced = self.tracer.enabled
+        ids64 = np.asarray(arr, np.int64)
+        # ISSUE-15: pin the request's adapter slot FIRST — acquire
+        # bumps the bank-row refcount for exactly the sequence's
+        # lifetime (released in _evict_slot), so an unregister racing
+        # this admission either loses (we hold the pin) or wins (the
+        # name is gone and THIS request fails 400-style; the batch is
+        # untouched). The uid seed keys the prefix lookup below: same
+        # tokens under a different adapter can never share KV.
+        aslot, aseed = 0, b""
+        if self.adapters is not None:
+            aname = getattr(req, "adapter", None)
+            try:
+                aslot, aseed = self.adapters.acquire(aname)
+            except ThreadDeath:
+                raise
             except Exception as e:
-                # an eviction-path fault (kv.prefix_evict chaos) is THIS
-                # request's admission failure, never a dead worker:
-                # reserve's undo left the pool byte-identical, so fail the
-                # one request and keep admitting (exactly-once terminal)
-                if traced and tr is not None:
-                    tr.child("kv_reserve", t_kv, self.tracer.now_us(),
-                             error=repr(e))
-                if self.adapters is not None:
-                    self.adapters.release(aslot)
                 self._fail(req, e)
-                continue
-            if traced and tr is not None:
-                tr.child("kv_reserve", t_kv, self.tracer.now_us(),
-                         blocks=self.kv_cache.blocks_for(plen + max_new))
-            self._end_queue_wait([req])
-            seq = _SlotSeq(req, rid, ids64, arr.dtype, max_new, seq_n)
-            seq.adapter = aslot
-            seq.adapter_seed = aseed
-            seq.table = self.kv_cache.block_table(rid,
-                                                  pad_to=self.table_width)
-            if hit is not None:
-                # rows already resident after revalidation: reserve set the
-                # committed length to the acquired shared blocks — chunked
-                # prefill resumes at the first novel token (~O(new tokens))
-                got = int(self.kv_cache.length(rid))
-                seq.prefix_hit = got
-                seq.pos = seq.length = got
-                seq.digests = hit.digests
-                if got:
-                    self.metrics.inc("prefix_hit_tokens", got)
-                    self._prefix_hit_counter.inc(got)
+                return True
+            self._lora_requests_counter.labels(
+                self._component,
+                "base" if aname is None else aname).inc()
+        hit, t_px = None, 0.0
+        pc = self.prefix_cache
+        if pc is not None:
+            t_px = self.tracer.now_us() if traced else 0.0
+            try:
+                hit = pc.lookup(ids64, seed=aseed)  # kv.prefix_match
+            except ThreadDeath:
+                raise
+            except Exception as e:
+                # a broken index lookup is a cache MISS, never a failed
+                # request — the cold path below is always correct
                 if traced and tr is not None:
                     tr.child("prefix_lookup", t_px, self.tracer.now_us(),
-                             matched_blocks=len(hit.pairs),
-                             hit_tokens=got)
+                             error=repr(e))
+                hit = None
+        t_kv = self.tracer.now_us() if traced else 0.0
+        try:
+            self.kv_cache.reserve(
+                rid, plen + max_new,
+                shared=hit.pairs if hit is not None else None)
+        except CacheOutOfBlocks as e:
+            if traced and tr is not None:
+                tr.child("kv_reserve", t_kv, self.tracer.now_us(),
+                         error=repr(e))
+            if self.adapters is not None:
+                self.adapters.release(aslot)
+            self._shed_or_defer(req, e)
+            return False
+        except Exception as e:
+            # an eviction-path fault (kv.prefix_evict chaos) is THIS
+            # request's admission failure, never a dead worker:
+            # reserve's undo left the pool byte-identical, so fail the
+            # one request and keep admitting (exactly-once terminal)
+            if traced and tr is not None:
+                tr.child("kv_reserve", t_kv, self.tracer.now_us(),
+                         error=repr(e))
+            if self.adapters is not None:
+                self.adapters.release(aslot)
+            self._fail(req, e)
+            return True
+        if traced and tr is not None:
+            tr.child("kv_reserve", t_kv, self.tracer.now_us(),
+                     blocks=self.kv_cache.blocks_for(plen + max_new))
+        self._end_queue_wait([req])
+        seq = _SlotSeq(req, rid, ids64, arr.dtype, max_new, seq_n)
+        seq.adapter = aslot
+        seq.adapter_seed = aseed
+        if self.qos is not None:
+            # ISSUE-17: bill the slot to its tenant — the inflight count is
+            # held for exactly the RUNNING span (pause releases it, resume
+            # re-takes it, every evict path drops it), and the expected
+            # service cost advances the tenant's virtual-time clock ONCE,
+            # here: _qos_pick admits the smallest clock first, which is what
+            # makes steady-state throughput weight-proportional
+            spec = self._tenant_spec_of(req)
+            seq.tenant = spec.name
+            seq.priority = spec.priority
+            self.qos.acquire(spec.name, cost=plen + max_new)
+            seq.qos_held = True
+            self.qos.note_admitted(spec.name)
+        seq.table = self.kv_cache.block_table(rid,
+                                              pad_to=self.table_width)
+        if hit is not None:
+            # rows already resident after revalidation: reserve set the
+            # committed length to the acquired shared blocks — chunked
+            # prefill resumes at the first novel token (~O(new tokens))
+            got = int(self.kv_cache.length(rid))
+            seq.prefix_hit = got
+            seq.pos = seq.length = got
+            seq.digests = hit.digests
+            if got:
+                self.metrics.inc("prefix_hit_tokens", got)
+                self._prefix_hit_counter.inc(got)
+            if traced and tr is not None:
+                tr.child("prefix_lookup", t_px, self.tracer.now_us(),
+                         matched_blocks=len(hit.pairs),
+                         hit_tokens=got)
+        with self._slot_lock:
+            self._slots[idx] = seq
+        self.metrics.inc("admitted_seqs")
+        if tr is not None:
+            tr.event("admitted", slot=idx, prompt_len=plen,
+                     max_new=max_new)
+        return True
+
+    # ------------------------------------------------- multi-tenant QoS tick
+    def _qos_admit(self):
+        """Fair-share admission (qos= knob): free slots go to the waiting
+        work — paused sequences AND queued arrivals, unified — of the most
+        urgent tier's most under-served tenant; then strictly more urgent
+        waiters preempt the least urgent running sequences. Host-side
+        bookkeeping only: the step launches (and so the compile surface)
+        are byte-identical to the untenanted scheduler's."""
+        while True:     # drain arrivals into the reorder backlog
+            try:
+                self._backlog.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        if (not self._backlog and not self._paused
+                and self._phase_count(None) == 0):
+            try:        # fully idle: park briefly instead of spinning
+                self._backlog.append(self._queue.get(timeout=0.05))
+            except queue.Empty:
+                return
+        while True:
+            idx = self._free_slot()
+            if idx is None:
+                break
+            pick = self._qos_pick()
+            if pick is None:
+                break
+            kind, item = pick
+            if kind == "resume":
+                self._resume_seq(idx, item)
+            elif not self._install_seq(idx, item):
+                return      # pool dry: stop admitting this tick
+        self._preempt_for_priority()
+
+    def _qos_pick(self):
+        """Best waiting work item: ('resume', seq) | ('admit', req) | None.
+
+        Order: priority tier first (lower = more urgent), then the
+        tenant's fair-share deficit (inflight/weight — the MINIMUM is the
+        most under-served, so contended slots converge to weight shares),
+        then resumes before fresh admissions (a paused sequence holds
+        blocks; finishing it frees memory), then arrival order."""
+        while True:
+            best = best_key = kind = None
+            for s in self._paused:
+                k = (s.priority, self.qos.fair_ratio(s.tenant), 0, s.order)
+                if best_key is None or k < best_key:
+                    best_key, best, kind = k, s, "resume"
+            for pos, r in enumerate(self._backlog):
+                spec = self._tenant_spec_of(r)
+                k = (spec.priority, self.qos.fair_ratio(spec.name), 1, pos)
+                if best_key is None or k < best_key:
+                    best_key, best, kind = k, r, "admit"
+            if best is None:
+                return None
+            if kind == "resume":
+                try:
+                    self._paused.remove(best)
+                except ValueError:  # pragma: no cover - raced an evict
+                    continue
+                return ("resume", best)
+            self._backlog.remove(best)
+            if not self._usable(best):
+                continue
+            return ("admit", best)
+
+    def _preempt_for_priority(self):
+        """Priority preemption: while a waiting request (or paused
+        sequence) is STRICTLY more urgent than the least urgent running
+        sequence, pause that victim — blocks retained, slot state parked,
+        tick width freed — and hand its slot to the waiter. Equal tiers
+        never preempt each other (fair share handles those), so the loop
+        terminates: each round strictly improves the worst running tier."""
+        while self._backlog or self._paused:
+            if self._free_slot() is not None:
+                return      # width available; the admit loop already ran
+            wprio = None
+            for s in self._paused:
+                wprio = (s.priority if wprio is None
+                         else min(wprio, s.priority))
+            for r in self._backlog:
+                if r.state != _PENDING:
+                    continue
+                p = self._tenant_spec_of(r).priority
+                wprio = p if wprio is None else min(wprio, p)
+            if wprio is None:
+                return
             with self._slot_lock:
-                self._slots[idx] = seq
-            self.metrics.inc("admitted_seqs")
-            if tr is not None:
-                tr.event("admitted", slot=idx, prompt_len=plen,
-                         max_new=max_new)
+                victim, vi = None, -1
+                for i, s in enumerate(self._slots):
+                    if s is None:
+                        continue
+                    if (victim is None or (s.priority, s.order)
+                            > (victim.priority, victim.order)):
+                        victim, vi = s, i
+            if victim is None or victim.priority <= wprio:
+                return
+            self._pause_slot(vi, victim)
+            idx = self._free_slot()
+            pick = self._qos_pick() if idx is not None else None
+            if pick is None:
+                return      # victim resumes via a later tick's admit loop
+            kind, item = pick
+            if kind == "resume":
+                self._resume_seq(idx, item)
+            elif not self._install_seq(idx, item):
+                return      # pool dry (the paused victim keeps its blocks)
+
+    def _pause_slot(self, i, s):
+        """Preempt a running sequence: park it off-slot with its blocks
+        RETAINED (the rid stays reserved — preemption frees tick width,
+        not memory; adapter pin included, so an unload can't race a paused
+        sequence either) and release its tenant's fair-share count. The
+        parked pos/tok/length/table bookkeeping is exactly the state a
+        prefix-hit admission produces, so resume is plain continuation —
+        bit-identical tokens, zero new compiled programs."""
+        t0 = self.tracer.now_us() if self.tracer.enabled else 0.0
+        with self._slot_lock:
+            if self._slots[i] is s:
+                self._slots[i] = None
+        if s.qos_held:
+            s.qos_held = False
+            self.qos.release(s.tenant)
+        self._paused.append(s)
+        self.metrics.inc("preempted_seqs")
+        tr = s.req.trace
+        if tr is not None:
+            tr.child("preempt", t0, self.tracer.now_us(), slot=i,
+                     phase=s.phase, committed=int(s.length))
+
+    def _resume_seq(self, idx, s):
+        """Reinstall a paused sequence into a free slot: its blocks and
+        pos/length bookkeeping never left, so the next tick continues it
+        exactly where it stopped (mid-prefill resumes its chunk walk at
+        pos — the prefix-hit continuation path; mid-decode feeds tok back
+        to the decode launch)."""
+        t0 = self.tracer.now_us() if self.tracer.enabled else 0.0
+        if self.qos is not None and not s.qos_held:
+            self.qos.acquire(s.tenant)
+            s.qos_held = True
+        with self._slot_lock:
+            self._slots[idx] = s
+        self.metrics.inc("resumed_seqs")
+        tr = s.req.trace
+        if tr is not None:
+            tr.child("resume", t0, self.tracer.now_us(), slot=idx,
+                     phase=s.phase, committed=int(s.length))
 
     def _next_request(self, block):
         """One queue pop under the admit policy.
@@ -866,6 +1156,24 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
         with self._slot_lock:
             if self._slots[i] is s:
                 self._slots[i] = None
+        self._release_seq(s)
+
+    def _evict_paused(self, s):
+        """Paused-sequence twin of _evict_slot: unpark and release (the
+        blocks a preempted sequence retained must not outlive it either)."""
+        try:
+            self._paused.remove(s)
+        except ValueError:  # pragma: no cover - already unparked
+            pass
+        self._release_seq(s)
+
+    def _release_seq(self, s):
+        """Return a sequence's held resources: tenant fair-share count,
+        adapter bank pin, KV blocks. Idempotent on every leg (double-evict
+        from shutdown racing retirement releases exactly once)."""
+        if self.qos is not None and s.qos_held:
+            s.qos_held = False
+            self.qos.release(s.tenant)
         if self.adapters is not None and s.adapter != 0:
             # drop the admission-time bank-slot pin; zeroing first makes a
             # double-evict (shutdown racing retirement) release exactly once
@@ -891,6 +1199,10 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
         except (KeyError, ValueError):  # pragma: no cover - audit-only state
             pass
         self._finish_req(s.req, out.astype(s.out_dtype))
+        if self.qos is not None and s.tenant is not None:
+            # useful tokens by tenant (ISSUE-17): the fairness bench's
+            # numerator is work DELIVERED at retirement, not work admitted
+            self.qos.account(s.tenant, len(s.generated[:s.max_new]))
         self._evict_slot(i, s)
         self.metrics.inc("retired_seqs")
         self._tokens_total.labels(self._component).inc(len(s.generated))
@@ -916,6 +1228,23 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
                         "deadline expired mid-decode (continuous tick)")):
                     self.metrics.inc("expired_in_flight")
                 self._evict_slot(i, s)
+                self.metrics.inc("retired_seqs")
+        # paused (preempted) sequences age under the same contract: a
+        # cancelled or expired one frees its retained blocks NOW instead of
+        # waiting to be resumed (exactly-once terminal via the request CAS)
+        for s in list(self._paused):
+            req = s.req
+            if req.state != _PENDING:
+                self.metrics.inc("cancelled_skipped")
+                if req.trace is not None:
+                    req.trace.event("paused_reclaimed_after_cancel")
+                self._evict_paused(s)
+                self.metrics.inc("retired_seqs")
+            elif req.deadline is not None and req.deadline.expired():
+                if self._fail(req, DeadlineExceeded(
+                        "deadline expired while preempted (paused)")):
+                    self.metrics.inc("expired_in_flight")
+                self._evict_paused(s)
                 self.metrics.inc("retired_seqs")
 
     def _absorb(self, i, s, toks) -> bool:
@@ -1230,6 +1559,15 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
                 if s.req.trace is not None:
                     s.req.trace.event("requeued_after_thread_death")
                 self._enqueue(s.req)
+        for s in list(self._paused):
+            # paused sequences lose their progress with the thread too:
+            # blocks back to the pool, still-pending requests re-enter the
+            # queue and re-run from scratch (correctness over cleverness)
+            self._evict_paused(s)
+            if s.req.state == _PENDING:
+                if s.req.trace is not None:
+                    s.req.trace.event("requeued_after_thread_death")
+                self._enqueue(s.req)
 
     def _shutdown_slots(self):
         """stop() path: nobody hangs on a closed scheduler."""
@@ -1239,6 +1577,10 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
             self._fail(s.req, ServiceUnavailable("predictor closed",
                                                  retry_after=None))
             self._evict_slot(i, s)
+        for s in list(self._paused):
+            self._fail(s.req, ServiceUnavailable("predictor closed",
+                                                 retry_after=None))
+            self._evict_paused(s)
         self._drain_backlog()
 
     def _drain_backlog(self):

@@ -50,7 +50,24 @@ from .resilience import (
 
 __all__ = ["BatchingPredictor", "GenerateBatchingPredictor",
            "ContinuousGenerateBatchingPredictor", "InferenceServer",
-           "ReplicaFleet"]
+           "ReplicaFleet", "retry_after_header", "RETRY_AFTER_CAP"]
+
+# Retry-After ceiling (seconds): a rate-limited tenant with a deep token
+# debt should re-probe within a minute, not sleep out the whole debt — the
+# server's picture of its own load is stale long before that.
+RETRY_AFTER_CAP = 60.0
+
+
+def retry_after_header(retry_after, cap=RETRY_AFTER_CAP) -> str:
+    """Retry-After header value from a shed's computed hint: ceil to whole
+    seconds (the header is integral), floor 1 (clients treat 0 as "retry
+    immediately" — that is how retry storms start), cap at `cap`. A hint-
+    less shed (None) gets the 1s floor — a 429/503 without Retry-After
+    makes clients invent their own backoff."""
+    if retry_after is None:
+        return "1"
+    return str(int(min(max(1, math.ceil(float(retry_after))),
+                       math.ceil(cap))))
 
 
 def __getattr__(name):
@@ -75,7 +92,8 @@ class _Request:
 
     __slots__ = ("arrays", "event", "result", "error", "deadline", "retries",
                  "defers", "t0", "trace", "enq_us", "max_new", "temperature",
-                 "top_k", "spec", "adapter", "on_tokens", "_lock", "_state")
+                 "top_k", "spec", "adapter", "tenant", "on_tokens", "_lock",
+                 "_state")
 
     def __init__(self, arrays, deadline=None, trace=None):
         self.arrays = arrays
@@ -93,6 +111,7 @@ class _Request:
         self.top_k = None
         self.spec = None        # tri-state speculative opt-out (continuous)
         self.adapter = None     # LoRA adapter name (ISSUE-15, continuous)
+        self.tenant = None      # QoS tenant name (ISSUE-17, continuous)
         # streaming delivery channel (ISSUE-11): set by infer_stream before
         # enqueue, called by the scheduler's tick loop with each newly
         # absorbed token chunk; None = buffered (non-streaming) request
@@ -161,6 +180,11 @@ class BatchingPredictor:
     # banked step programs; X-Adapter against a whole-batch predictor is a
     # client misroute -> 400, same taxonomy as the sampler headers
     supports_adapters = False
+
+    # multi-tenant QoS (ISSUE-17) lives in the continuous scheduler's
+    # tenant ledger; X-Tenant against a whole-batch predictor is the same
+    # client misroute -> 400
+    supports_tenants = False
 
     _component = "batcher"      # prometheus `component` label value
 
@@ -234,7 +258,7 @@ class BatchingPredictor:
         return _Request(arrays, deadline,
                         trace=RequestTrace(self.tracer, trace_id))
 
-    def _admission_check(self, arrays):
+    def _admission_check(self, arrays, req=None):
         self.admission.admit(self._queue.qsize())
 
     def _enqueue(self, req):
@@ -264,7 +288,7 @@ class BatchingPredictor:
                 raise ServiceUnavailable(
                     "circuit open after repeated predictor failures",
                     retry_after=self.breaker.retry_after())
-            self._admission_check(req.arrays)
+            self._admission_check(req.arrays, req)
         except Rejected as e:
             self.metrics.inc("rejected_busy" if isinstance(e, ServerBusy)
                              else "rejected_unavailable")
@@ -598,7 +622,7 @@ class GenerateBatchingPredictor(BatchingPredictor):
                                  trace_id)
         return self._submit(req)
 
-    def _admission_check(self, arrays):
+    def _admission_check(self, arrays, req=None):
         need = self.kv_cache.blocks_for(len(arrays[0]) + self.max_new_tokens)
         self.admission.admit(self._queue.qsize(), cache=self.kv_cache,
                              blocks_needed=need)
@@ -823,14 +847,16 @@ class InferenceServer:
                 headers = []
                 if isinstance(e, Rejected):
                     status = e.status
-                    retry = e.retry_after if e.retry_after is not None else 1
+                    # computed hint (e.g. a tenant bucket's time-to-refill)
+                    # capped and floored by retry_after_header — never the
+                    # old flat 1s floor when the shed knows better
                     headers.append(("Retry-After",
-                                    str(max(1, math.ceil(retry)))))
+                                    retry_after_header(e.retry_after)))
                 elif isinstance(e, TimeoutError):
                     status = 504
                 elif isinstance(e, CacheOutOfBlocks):
                     status = 503
-                    headers.append(("Retry-After", "1"))
+                    headers.append(("Retry-After", retry_after_header(None)))
                 elif isinstance(e, ValueError):
                     status = 400
                 else:
@@ -909,6 +935,23 @@ class InferenceServer:
                             "an AdapterRegistry (adapters= knob); this "
                             "server's generator serves the base model only")
                     kw["adapter"] = av
+                # X-Tenant (ISSUE-17): QoS billing by ledger tenant name.
+                # Same strict taxonomy again — empty name or a ledger-less
+                # generator is a client bug (400), an UNKNOWN name 400s
+                # from the scheduler's synchronous _route_tenant (never a
+                # silent ride on the default tenant)
+                tn = self.headers.get("X-Tenant")
+                if tn is not None:
+                    tv = tn.strip()
+                    if not tv:
+                        raise ValueError("malformed X-Tenant (empty name)")
+                    if not getattr(outer.generator,
+                                   "supports_tenants", False):
+                        raise ValueError(
+                            "X-Tenant needs the continuous scheduler with "
+                            "a TenantLedger (qos= knob); this server's "
+                            "generator serves untenanted traffic only")
+                    kw["tenant"] = tv
                 return kw
 
             def do_GET(self):
@@ -1210,6 +1253,14 @@ class ReplicaFleet:
         so X-Adapter routing works iff the replicas carry it — any replica
         answers for the fleet."""
         return any(getattr(rep.predictor, "supports_adapters", False)
+                   for rep in self._snapshot())
+
+    @property
+    def supports_tenants(self):
+        """X-Tenant twin of supports_adapters (ISSUE-17): build() passes
+        one shared TenantLedger to every replica (qos= knob), so tenant
+        routing works iff the replicas carry it."""
+        return any(getattr(rep.predictor, "supports_tenants", False)
                    for rep in self._snapshot())
 
     def __init__(self, replicas, *, admission=None, registry=None,

@@ -272,6 +272,9 @@ def test_llama_generate_token_parity():
         np.testing.assert_array_equal(got, want, err_msg=kern)
 
 
+@pytest.mark.slow   # ~10s: ISSUE-17 wall paydown — ragged-batch paged parity
+# stays anchored tier-1 by test_generate_batching_predictor_serves_mixed_lengths
+# (same paged API through the batcher) + the continuous-serving dense references
 def test_generate_paged_mixed_lengths_match_dense():
     from paddle_tpu.inference.kv_cache import PagedKVCache
 

@@ -92,7 +92,9 @@ def _read_losses(tmp_path, rank):
 
 
 @pytest.mark.parametrize("mode,expect_free_restart", [
-    ("crash", False),
+    # crash (~17s) rides the slow tier: preempt exercises the same
+    # restart/continuity assertions PLUS the SIGTERM autocheckpoint path.
+    pytest.param("crash", False, marks=pytest.mark.slow),
     ("preempt", True),
 ])
 def test_kill_mid_step_resumes_with_loss_continuity(tmp_path, mode,

@@ -1,0 +1,645 @@
+"""Multi-tenant QoS + elasticity (ISSUE-17).
+
+The contract under test, in order of importance:
+
+1. Pause/resume is BIT-EXACT: a preempted-then-resumed sequence (greedy
+   AND seeded-sampled, including one admitted through a prefix-cache hit)
+   produces the same tokens as an uninterrupted run, with zero new
+   compiled programs — preemption is host-side bookkeeping only.
+2. The tenant ledger's rate limit sheds with a COMPUTED Retry-After (the
+   bucket's time-to-refill, capped by retry_after_header), never the old
+   flat 1s floor; unknown tenants are a strict 400, the X-Adapter taxonomy.
+3. Failure posture: an injected ``qos.ledger`` fault degrades the rate
+   limit to admit-all (a broken ledger never wedges admission); an
+   injected ``fleet.scale_up`` fault leaves the fleet serving on the
+   survivors.
+4. The autoscaler closes the loop observability -> topology: flash crowd
+   -> warmup-gated scale-up (a cold replica takes NO traffic until its
+   step programs are built) -> quiet -> drain-down, with exactly-once
+   terminals and pool conservation throughout.
+
+Every serving leg is chaos-marked: lock witness + post-ready compile
+sentinel armed (tests/conftest.py autouse fixtures).
+"""
+import io
+import itertools
+import math
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.faults import FaultInjector
+from paddle_tpu.inference.qos import (
+    FleetAutoscaler,
+    TenantLedger,
+    TenantSpec,
+)
+from paddle_tpu.inference.resilience import ServerBusy
+from paddle_tpu.inference.serving import (
+    RETRY_AFTER_CAP,
+    ReplicaFleet,
+    retry_after_header,
+)
+from paddle_tpu.observability.metrics import (
+    MetricsRegistry,
+    render_prometheus,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+# ================================================== ledger units (no model)
+def test_tenant_spec_validation():
+    s = TenantSpec("gold", weight=3.0, priority=0, rate=100.0)
+    assert s.burst == 400.0                       # default burst = 4x rate
+    assert TenantSpec("free").rate is None
+    with pytest.raises(ValueError, match="weight"):
+        TenantSpec("t", weight=0.0)
+    with pytest.raises(ValueError, match="priority"):
+        TenantSpec("t", priority=-1)
+    with pytest.raises(ValueError, match="rate"):
+        TenantSpec("t", rate=0.0)
+    with pytest.raises(ValueError, match="burst"):
+        TenantSpec("t", rate=0.01)                # 4x rate bursts < 1 token
+
+
+def test_ledger_resolve_strict_unknown_and_default():
+    led = TenantLedger()
+    assert led.resolve(None).name == "default"    # None rides the default
+    with pytest.raises(ValueError, match="unknown tenant 'ghost'"):
+        led.resolve("ghost")
+    led.register("gold", weight=3.0, priority=0)
+    assert led.resolve("gold").weight == 3.0
+    assert led.tenant_names() == ["default", "gold"]
+
+
+def test_ledger_bucket_math_and_computed_retry_after_on_fake_clock():
+    clk = [0.0]
+    led = TenantLedger(clock=lambda: clk[0])
+    led.register("bronze", rate=10.0, burst=40.0)
+    led.charge("bronze", 40)                      # drains the full burst
+    with pytest.raises(ServerBusy) as ei:
+        led.charge("bronze", 10)
+    # empty bucket, 10 tokens at 10 tok/s -> exactly 1s to refill
+    assert ei.value.retry_after == pytest.approx(1.0)
+    assert ei.value.status == 429
+    clk[0] += 1.0                                 # refill lands
+    led.charge("bronze", 10)
+    with pytest.raises(ServerBusy) as ei:         # and is spent again
+        led.charge("bronze", 25)
+    assert ei.value.retry_after == pytest.approx(2.5)
+    snap = led.snapshot()["bronze"]
+    assert snap["rate_limited"] == 2
+    # re-registering (weight change) keeps the bucket's debt
+    led.register("bronze", weight=2.0, rate=10.0, burst=40.0)
+    with pytest.raises(ServerBusy):
+        led.charge("bronze", 40)
+
+
+def test_retry_after_header_floor_ceil_and_cap():
+    assert retry_after_header(None) == "1"        # no estimate: legacy floor
+    assert retry_after_header(0.004) == "1"       # sub-second floors to 1
+    assert retry_after_header(2.3) == "3"         # ceil, client can trust it
+    assert retry_after_header(1e9) == str(int(math.ceil(RETRY_AFTER_CAP)))
+    assert retry_after_header(7.2, cap=5.0) == "5"
+
+
+def test_ledger_fair_ratio_tracks_weighted_virtual_service():
+    led = TenantLedger()
+    led.register("gold", weight=3.0)
+    led.register("bronze", weight=1.0)
+    # gold's weight-3 clock advances 3x slower per unit of work billed, so
+    # min-fair_ratio admission keeps picking gold until it holds 3x the
+    # service
+    led.acquire("gold", cost=30.0)                # start 0, finish 30/3
+    assert led.fair_ratio("gold") == pytest.approx(10.0)
+    led.acquire("bronze", cost=30.0)              # vtime 0: no clamp; 30/1
+    assert led.fair_ratio("bronze") == pytest.approx(30.0)
+    led.acquire("gold", cost=60.0)                # 90 vs 30 work: even clocks
+    assert led.fair_ratio("gold") == led.fair_ratio("bronze")
+    # a resume re-takes the slot with cost 0: the clock must not move
+    led.release("gold")
+    led.acquire("gold")
+    assert led.fair_ratio("gold") == pytest.approx(30.0)
+    # SFQ idle-return clamp: a tenant arriving while others run starts at
+    # the running virtual time (min START tag), not at its stale clock —
+    # no famine ticket for having been idle
+    led.release("gold")
+    led.release("gold")
+    led.release("bronze")                         # ledger fully idle
+    led.acquire("gold", cost=30.0)                # start 30 (own clock), F 40
+    led.register("silver", weight=1.0)
+    led.acquire("silver", cost=1.0)               # clamped to gold's start 30
+    assert led.fair_ratio("silver") == pytest.approx(31.0)
+    with pytest.raises(ValueError):
+        led.acquire("ghost")
+
+
+def test_ledger_metrics_bind_idempotent_and_render():
+    reg = MetricsRegistry()
+    led = TenantLedger()
+    led.register("gold", weight=3.0)
+    led.bind_metrics(reg)
+    led.bind_metrics(reg)                         # fleet twin: a no-op
+    led.note_admitted("gold")
+    led.account("gold", 7)
+    led.acquire("gold")
+    prom = render_prometheus(reg)
+    assert 'paddle_tenant_requests_total{tenant="gold"} 1' in prom
+    assert 'paddle_tenant_tokens_total{tenant="gold"} 7' in prom
+    assert 'paddle_tenant_inflight{tenant="gold"} 1' in prom
+    assert "paddle_qos_ledger_degraded_total 0" in prom
+
+
+# ======================================= autoscaler control loop (fake fleet)
+class _FakePredictor:
+    def __init__(self):
+        self.kv_util = 0.0
+        self.backlog = {}
+        self._pending = 0
+
+    @property
+    def kv_cache(self):
+        pred = self
+
+        class KV:
+            live_utilization = pred.kv_util
+        return KV()
+
+    def tenant_backlog(self):
+        return dict(self.backlog)
+
+    def pending(self):
+        return self._pending
+
+
+class _FakeRep:
+    def __init__(self, name):
+        self.name = name
+        self.state = "ready"
+        self.predictor = _FakePredictor()
+
+
+class _FakeFleet:
+    def __init__(self):
+        self.registry = MetricsRegistry()
+        self.reps = [_FakeRep("r0")]
+        self.pending_v = 0
+        self.added = []
+        self.retired = []
+
+    def pending(self):
+        return self.pending_v
+
+    def _snapshot(self):
+        return list(self.reps)
+
+    def _refresh(self, rep):
+        return rep.state
+
+    def add_replica(self, **kw):
+        self.added.append(kw)
+        self.reps.append(_FakeRep(f"r{len(self.reps)}"))
+
+    def retire_replica(self, name, drain_timeout):
+        self.retired.append((name, drain_timeout))
+        self.reps = [r for r in self.reps if r.name != name]
+
+
+def test_autoscaler_thresholds_cooldown_and_clamps():
+    clk = [0.0]
+    fleet = _FakeFleet()
+    auto = FleetAutoscaler(
+        fleet, min_replicas=1, max_replicas=3, scale_up_pending=8,
+        scale_up_kv_util=0.85, scale_up_backlog=16, scale_down_pending=0,
+        scale_down_kv_util=0.25, cooldown_s=5.0, drain_timeout=0.0,
+        replica_overrides={"warmup": True}, clock=lambda: clk[0])
+    assert auto.tick() is None                    # quiet fleet at min: hold
+    fleet.pending_v = 8                           # pressure: queue depth
+    assert auto.tick() == "up"
+    assert fleet.added == [{"warmup": True}]      # overrides reach the build
+    assert auto.tick() is None                    # cooldown holds the 2nd up
+    clk[0] += 6.0
+    fleet.pending_v = 0
+    fleet.reps[0].predictor.kv_util = 0.9         # pressure: KV residency
+    assert auto.tick() == "up"
+    clk[0] += 6.0
+    fleet.reps[0].predictor.kv_util = 0.0
+    fleet.reps[0].predictor.backlog = {"bronze": 20}   # pressure: starvation
+    assert auto.tick() is None                    # ...but already at max=3
+    # and a starving tenant VETOES a drain even though pending/kv are quiet
+    assert len(fleet.reps) == 3 and fleet.retired == []
+    auto.max_replicas = 4
+    assert auto.tick() == "up"                    # veto didn't eat cooldown
+    fleet.reps[0].predictor.backlog = {}
+    clk[0] += 6.0
+    assert auto.tick() == "down"                  # all quiet: drain one
+    assert fleet.retired == [("r0", 0.0)]         # least-pending victim
+    clk[0] += 6.0
+    fleet.reps = fleet.reps[:1]
+    assert auto.tick() is None                    # at min_replicas: hold
+    with pytest.raises(ValueError):
+        FleetAutoscaler(fleet, min_replicas=3, max_replicas=2)
+
+
+# ============================================================ serving legs
+@pytest.fixture(scope="module")
+def small_gpt():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    with paddle.utils.unique_name.guard():
+        paddle.seed(7)
+        m = GPTForCausalLM(GPTConfig(vocab_size=128, hidden_size=64,
+                                     num_layers=2, num_heads=4,
+                                     num_kv_heads=2, max_position=64,
+                                     dropout=0.0))
+    m.eval()
+    return m
+
+
+def _dense_ref(m, prompt, new, **kw):
+    out = m.generate(paddle.to_tensor(np.asarray(prompt)[None]),
+                     max_new_tokens=new, dtype=None, decode_kernel="xla",
+                     **kw)
+    return np.asarray(out._value)[0]
+
+
+def _continuous(m, **kw):
+    from paddle_tpu.inference.scheduler import (
+        ContinuousGenerateBatchingPredictor,
+    )
+
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("prefill_chunk", 4)
+    kw.setdefault("decode_steps", 2)
+    kw.setdefault("max_new_tokens", 3)
+    kw.setdefault("decode_kernel", "xla")
+    kw.setdefault("block_size", 8)
+    kw.setdefault("num_blocks", 16)
+    kw.setdefault("max_seq_len", 16)
+    return ContinuousGenerateBatchingPredictor(m, **kw)
+
+
+def _two_tier_ledger():
+    led = TenantLedger()
+    led.register("bg", weight=1.0, priority=2)    # preemptible background
+    led.register("fg", weight=1.0, priority=0)    # latency-critical
+    return led
+
+
+def _preempt_round(gp, f, vp, hp, v_new, h_new, v_knobs=None):
+    """Run the canonical preemption interleaving and return (victim_out,
+    interloper_out): the victim ('bg') stalls in its first decode launch
+    (delay fault), the interloper ('fg', strictly more urgent) arrives
+    mid-stall and pauses it; the victim resumes after the interloper
+    retires. max_slots=1 makes the schedule deterministic."""
+    base = f.calls("predictor.generate")
+    f.install("predictor.generate", delay=0.75, after=base + 1, times=1)
+    res = {}
+
+    def victim():
+        res["v"] = np.asarray(gp.infer(vp, timeout=120, max_new_tokens=v_new,
+                                       tenant="bg", **(v_knobs or {})))
+
+    tv = threading.Thread(target=victim)
+    tv.start()
+    deadline = time.monotonic() + 30
+    while f.fired("predictor.generate") == 0 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert f.fired("predictor.generate") == 1     # victim mid-decode stall
+    res["h"] = np.asarray(gp.infer(hp, timeout=120, max_new_tokens=h_new,
+                                   tenant="fg"))
+    tv.join(timeout=120)
+    assert not tv.is_alive()
+    return res["v"], res["h"]
+
+
+def test_chaos_greedy_preempt_pause_resume_bit_parity(small_gpt):
+    """A high-priority arrival pauses the running low-priority decode
+    mid-sequence (blocks retained, slot width freed) and the victim
+    resumes to the SAME tokens an uninterrupted run produces — with zero
+    recompiles (the chaos sentinel is armed and the counter is pinned)."""
+    m = small_gpt
+    rng = np.random.default_rng(17)
+    vp = rng.integers(0, 128, 6).astype("int64")
+    hp = rng.integers(0, 128, 5).astype("int64")
+    f = FaultInjector()
+    gp = _continuous(m, faults=f, qos=_two_tier_ledger(), max_slots=1,
+                     prefill_chunk=8, max_new_tokens=6)
+    try:
+        v_out, h_out = _preempt_round(gp, f, vp, hp, v_new=5, h_new=3)
+        np.testing.assert_array_equal(v_out, _dense_ref(m, vp, 5))
+        np.testing.assert_array_equal(h_out, _dense_ref(m, hp, 3))
+        assert gp.metrics.get("preempted_seqs") == 1
+        assert gp.metrics.get("resumed_seqs") == 1
+        mm = gp.metrics
+        assert (mm.get("completed") + mm.get("failed")
+                + mm.get("timeouts")) == mm.get("accepted") == 2
+        for prog in ("prefill_chunk", "decode_step"):
+            assert gp._recompile_counter.labels(
+                gp._component, prog).value == 0, prog
+        assert gp.kv_cache.blocks_in_use == 0
+        gp.kv_cache.check_conservation()
+    finally:
+        gp.close()
+
+
+def test_chaos_sampled_preempt_pause_resume_bit_parity(small_gpt):
+    """Seeded-sampled parity: the scheduler draws ONE seed per step launch
+    (itertools.count), so the preempted run's victim consumes launch seeds
+    [1, 2, 5] (the interloper burns 3 and 4 while the victim is paused).
+    Rigging the uninterrupted reference scheduler's seed iterator to the
+    same sequence makes sampled outputs comparable bit-for-bit — any
+    pause/resume state corruption (pos, tok, KV rows) diverges them."""
+    m = small_gpt
+    rng = np.random.default_rng(23)
+    vp = rng.integers(0, 128, 6).astype("int64")
+    hp = rng.integers(0, 128, 5).astype("int64")
+    knobs = dict(temperature=0.9, top_k=4)
+
+    ref_gp = _continuous(m, max_slots=1, prefill_chunk=8, max_new_tokens=6)
+    try:
+        # victim launches: prefill, decode, decode -> draws 1, 2, then 5
+        ref_gp._seed = iter(itertools.chain([1, 2], itertools.count(5)))
+        ref = np.asarray(ref_gp.infer(vp, timeout=120, max_new_tokens=5,
+                                      **knobs))
+    finally:
+        ref_gp.close()
+
+    f = FaultInjector()
+    gp = _continuous(m, faults=f, qos=_two_tier_ledger(), max_slots=1,
+                     prefill_chunk=8, max_new_tokens=6)
+    try:
+        # interloper: plen <= prefill_chunk and max_new-1 <= decode_steps
+        # -> exactly two launches (seeds 3 and 4)
+        v_out, _ = _preempt_round(gp, f, vp, hp, v_new=5, h_new=3,
+                                  v_knobs=knobs)
+        np.testing.assert_array_equal(v_out, ref)
+        assert gp.metrics.get("preempted_seqs") == 1
+        assert gp.metrics.get("resumed_seqs") == 1
+        assert gp.kv_cache.blocks_in_use == 0
+        gp.kv_cache.check_conservation()
+    finally:
+        gp.close()
+
+
+def test_chaos_preempt_resume_across_prefix_cache_hit(small_gpt):
+    """The ISSUE acceptance's hardest composition: a sequence ADMITTED
+    through a warm prefix-cache hit (shared blocks, nonzero start pos) is
+    preempted mid-decode and resumed — still token-identical to its cold
+    run. Pause must not disturb shared-block refcounts or the hit-path
+    pos bookkeeping."""
+    m = small_gpt
+    rng = np.random.default_rng(29)
+    vp = rng.integers(0, 128, 6).astype("int64")
+    hp = rng.integers(0, 128, 5).astype("int64")
+    f = FaultInjector()
+    gp = _continuous(m, faults=f, qos=_two_tier_ledger(), max_slots=1,
+                     prefill_chunk=8, max_new_tokens=6, block_size=4,
+                     prefix_cache=True)
+    try:
+        cold = np.asarray(gp.infer(vp, timeout=120, max_new_tokens=5,
+                                   tenant="bg"))     # populates the index
+        v_out, h_out = _preempt_round(gp, f, vp, hp, v_new=5, h_new=3)
+        np.testing.assert_array_equal(v_out, cold)
+        np.testing.assert_array_equal(h_out, _dense_ref(m, hp, 3))
+        assert gp.metrics.get("prefix_hit_tokens") == 4   # (6-1)//4 * 4
+        assert gp.metrics.get("preempted_seqs") == 1
+        assert gp.metrics.get("resumed_seqs") == 1
+        # retired blocks PARK in the prefix index (evictable tier) rather
+        # than free — conservation, not blocks_in_use==0, is the invariant
+        gp.kv_cache.check_conservation()
+    finally:
+        gp.close()
+
+
+def test_chaos_qos_ledger_fault_degrades_to_admit_all(small_gpt):
+    """An injected qos.ledger fault must degrade the rate limit to
+    ADMIT-ALL — a broken ledger never wedges or fails admission — and the
+    degradations are counted. Once the fault clears, the limit is back."""
+    m = small_gpt
+    f = FaultInjector()
+    led = TenantLedger(clock=lambda: 0.0, faults=f)   # frozen bucket clock
+    led.register("limited", rate=1.0, burst=1.0)
+    gp = _continuous(m, faults=f, qos=led)
+    prompt = np.arange(2, 7, dtype="int64")           # cost 5 + 3 = 8 tokens
+    try:
+        with pytest.raises(ServerBusy) as ei:         # budget enforced cold
+            gp.infer(prompt, timeout=120, tenant="limited")
+        assert ei.value.retry_after == pytest.approx(7.0)   # (8-1)/1 tok/s
+
+        f.install("qos.ledger", error=RuntimeError("ledger backend down"),
+                  times=2)
+        ref = _dense_ref(m, prompt, 3)
+        for _ in range(2):                            # admit-all, served OK
+            np.testing.assert_array_equal(
+                gp.infer(prompt, timeout=120, tenant="limited"), ref)
+        assert led.degraded == 2
+        with pytest.raises(ServerBusy):               # fault gone: enforced
+            gp.infer(prompt, timeout=120, tenant="limited")
+        mm = gp.metrics
+        assert mm.get("completed") == 2               # nothing wedged
+        assert gp.kv_cache.blocks_in_use == 0
+        gp.kv_cache.check_conservation()
+    finally:
+        gp.close()
+
+
+def test_chaos_x_tenant_header_taxonomy_and_computed_retry_after(small_gpt):
+    """X-Tenant rides the X-Adapter taxonomy: routed when valid, 400 on
+    empty/unknown names and on ledger-less generators; a tenant over its
+    token budget gets 429 whose Retry-After is the bucket's computed
+    time-to-refill (here exactly 7s), not the old flat 1s floor."""
+    from paddle_tpu.inference.serving import InferenceServer
+
+    m = small_gpt
+    led = TenantLedger(clock=lambda: 0.0)             # frozen: no refill
+    led.register("gold", weight=3.0, priority=0)
+    led.register("bronze", rate=1.0, burst=1.0)
+    gp = _continuous(m, qos=led)
+    srv = InferenceServer(None, batching=False, generator=gp).start()
+    prompt = np.arange(2, 7, dtype="int64")
+
+    def post(srv_, headers):
+        buf = io.BytesIO()
+        np.savez(buf, ids=prompt)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv_.port}/generate", data=buf.getvalue(),
+            headers=headers)
+        r = urllib.request.urlopen(req, timeout=120)
+        return r.status, np.load(io.BytesIO(r.read()))["out0"]
+
+    try:
+        status, out = post(srv, {"X-Tenant": "gold"})
+        assert status == 200
+        np.testing.assert_array_equal(out, _dense_ref(m, prompt, 3))
+        status, _ = post(srv, {"X-Tenant": "  gold  "})    # whitespace ok
+        assert status == 200
+        for hdrs in ({"X-Tenant": ""}, {"X-Tenant": "   "},
+                     {"X-Tenant": "ghost"}):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                post(srv, hdrs)
+            assert ei.value.code == 400, hdrs
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post(srv, {"X-Tenant": "bronze"})         # cost 8 > burst 1
+        assert ei.value.code == 429
+        assert ei.value.headers["Retry-After"] == "7"  # ceil((8-1)/1 tok/s)
+        snap = led.snapshot()["bronze"]
+        assert snap["rate_limited"] == 1
+        srv.stop(drain_timeout=10)
+    finally:
+        srv.stop(drain_timeout=2)
+        gp.close()
+
+    # ledger-less scheduler: X-Tenant (and tenant=) are client misroutes
+    gp2 = _continuous(m)
+    assert gp2.supports_tenants is False
+    srv2 = InferenceServer(None, batching=False, generator=gp2).start()
+    try:
+        with pytest.raises(ValueError, match="TenantLedger"):
+            gp2.infer(prompt, timeout=60, tenant="gold")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post(srv2, {"X-Tenant": "gold"})
+        assert ei.value.code == 400
+    finally:
+        srv2.stop(drain_timeout=2)
+        gp2.close()
+
+
+def test_chaos_fleet_scale_up_fault_leaves_survivors_serving(small_gpt):
+    """Injected fleet.scale_up fault: the provision fails, the event counts
+    ``error``, and the fleet keeps serving on the survivors with zero
+    stranded requests; the cooldown-spaced retry then lands the replica,
+    and the quiet fleet drains back down."""
+    m = small_gpt
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, 128, 5).astype("int64")
+    ref = _dense_ref(m, prompt, 3)
+    f = FaultInjector()
+    fleet = ReplicaFleet.build(
+        m, n_replicas=1, max_slots=2, prefill_chunk=4, decode_steps=2,
+        max_new_tokens=3, decode_kernel="xla", block_size=8, num_blocks=16,
+        max_seq_len=16)
+    auto = FleetAutoscaler(fleet, min_replicas=1, max_replicas=2,
+                           scale_up_pending=0, cooldown_s=0.0,
+                           drain_timeout=5.0, faults=f)
+    try:
+        f.install("fleet.scale_up", error=RuntimeError("provision failed"),
+                  times=1)
+        assert auto.tick() == "up_failed"
+        assert list(fleet.replica_states()) == ["r0"]   # survivors only
+        np.testing.assert_array_equal(fleet.infer(prompt, timeout=120), ref)
+        assert auto.tick() == "up"                      # retry lands
+        states = fleet.replica_states()
+        assert len(states) == 2 and states["r0"] == "ready"
+        np.testing.assert_array_equal(fleet.infer(prompt, timeout=120), ref)
+        # lift the forced-pressure threshold: the fleet reads quiet now
+        # (pending 0 is no longer "pressure", which would veto a drain)
+        auto.scale_up_pending = 8
+        assert auto.tick() == "down"                    # quiet: drain one
+        assert sum(1 for s in fleet.replica_states().values()
+                   if s == "ready") == 1
+        np.testing.assert_array_equal(fleet.infer(prompt, timeout=120), ref)
+        prom = render_prometheus(fleet.registry)
+        for line in (
+            'paddle_fleet_scale_events_total{direction="up",outcome="error"} 1',
+            'paddle_fleet_scale_events_total{direction="up",outcome="ok"} 1',
+            'paddle_fleet_scale_events_total{direction="down",outcome="ok"} 1',
+        ):
+            assert line in prom, line
+        snap = dict(fleet.metrics.snapshot())
+        assert snap.get("accepted") == snap.get("completed") == 3
+    finally:
+        auto.stop()
+        fleet.close()
+
+
+def test_chaos_autoscale_flash_crowd_warmup_gated_then_drain_down(small_gpt):
+    """The ISSUE-17 acceptance leg: a flash crowd drives queue depth over
+    the threshold -> scale-up builds a WARMING replica (AOT-gated: while
+    its ready() is False the router must send it no traffic — asserted at
+    every poll of the warming window) -> every client completes exactly
+    once -> the quiet fleet drains back down, pool conserved."""
+    m = small_gpt
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, 128, 5).astype("int64")
+    ref = _dense_ref(m, prompt, 3)
+    led = TenantLedger()
+    led.register("crowd", weight=1.0, priority=1)
+    fleet = ReplicaFleet.build(
+        m, n_replicas=1, qos=led, max_slots=2, prefill_chunk=4,
+        decode_steps=2, max_new_tokens=3, decode_kernel="xla", block_size=8,
+        num_blocks=16, max_seq_len=16)
+    auto = FleetAutoscaler(fleet, min_replicas=1, max_replicas=2,
+                           scale_up_pending=4, scale_down_pending=0,
+                           scale_down_kv_util=0.25, cooldown_s=0.0,
+                           drain_timeout=5.0,
+                           replica_overrides={"warmup": True}, ledger=led)
+    N = 6
+    outs = [None] * N
+
+    def client(i):
+        try:
+            outs[i] = np.asarray(fleet.infer(prompt, timeout=300,
+                                             tenant="crowd"))
+        except Exception as e:  # noqa: BLE001 - storm bookkeeping
+            outs[i] = e
+
+    try:
+        ts = [threading.Thread(target=client, args=(i,)) for i in range(N)]
+        for t in ts:
+            t.start()
+        deadline = time.monotonic() + 60
+        while (fleet.pending() < auto.scale_up_pending
+               and time.monotonic() < deadline):
+            time.sleep(0.002)
+        assert auto.signals()["pending"] >= auto.scale_up_pending
+        assert auto.tick() == "up"
+        r1 = fleet._by_name("r1")
+        # warming window: the cold replica takes ZERO traffic until ready
+        deadline = time.monotonic() + 90
+        while not r1.predictor.ready() and time.monotonic() < deadline:
+            prom = render_prometheus(fleet.registry)
+            dispatched = [l for l in prom.splitlines()
+                          if l.startswith("paddle_fleet_dispatch_total")
+                          and 'replica="r1"' in l
+                          and not l.rstrip().endswith(" 0")]
+            assert dispatched == [], dispatched
+            time.sleep(0.01)
+        assert r1.predictor.ready()
+        assert r1.predictor.warm_stats()["missing"] == []
+
+        for t in ts:
+            t.join(timeout=300)
+        assert not any(t.is_alive() for t in ts)        # zero stranded
+        for o in outs:
+            assert isinstance(o, np.ndarray), o         # all completed
+            np.testing.assert_array_equal(o, ref)
+        snap = dict(fleet.metrics.snapshot())
+        assert snap.get("accepted") == snap.get("completed") == N
+        assert snap.get("failed", 0) == 0 and snap.get("timeouts", 0) == 0
+
+        deadline = time.monotonic() + 30
+        while fleet.pending() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert auto.tick() == "down"                    # quiet: retire one
+        assert sum(1 for s in fleet.replica_states().values()
+                   if s == "ready") == 1
+        np.testing.assert_array_equal(
+            fleet.infer(prompt, timeout=120, tenant="crowd"), ref)
+        assert led.snapshot()["crowd"]["tokens_done"] == 3 * (N + 1)
+        for rep in fleet._snapshot():                   # pool conservation
+            if fleet._refresh(rep) == "ready":
+                assert rep.predictor.kv_cache.blocks_in_use == 0
+                rep.predictor.kv_cache.check_conservation()
+        prom = render_prometheus(fleet.registry)
+        assert ('paddle_fleet_scale_events_total'
+                '{direction="up",outcome="ok"} 1') in prom
+    finally:
+        auto.stop()
+        fleet.close()

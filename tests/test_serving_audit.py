@@ -477,3 +477,60 @@ def test_multi_lora_bench_wires_churn_parity_and_fields():
     assert "unregister(" in src and "register(" in src
     assert "_runner_cache()" in src
     assert "slot0_parity" in src
+
+
+# ------------------------------------------------------------- ISSUE-17 qos
+def test_tenant_fairness_fields_weight_share_math_and_gate():
+    """ISSUE-17 starvation gate wiring: per-tenant delivered share of useful
+    tokens vs weight/sum-of-weights, min ratio across tenants, tok/s from
+    the window — audit "ok" iff every tenant keeps >= 90% of its share."""
+    out = {"window_s": 4.0, "tenants": {
+        "gold": {"weight": 3.0, "tokens_done": 450},
+        "bronze": {"weight": 1.0, "tokens_done": 150},
+    }}
+    bench.tenant_fairness_fields(out)
+    assert out["tenants"]["gold"]["fair_share"] == pytest.approx(0.75)
+    assert out["tenants"]["gold"]["delivered_share"] == pytest.approx(0.75)
+    assert out["tenants"]["bronze"]["fair_share_ratio"] == pytest.approx(1.0)
+    assert out["min_fair_share_ratio"] == pytest.approx(1.0)
+    assert out["useful_tokens_per_sec"] == pytest.approx(150.0)
+    assert out["audit"] == "ok"
+
+
+def test_tenant_fairness_fields_flags_worst_starved_tenant():
+    # equal delivered tokens under 3:1 weights — the aggressor grabbed half
+    # the fleet: gold's ratio 0.5/0.75 drops below the 0.9 floor
+    out = {"tenants": {
+        "gold": {"weight": 3.0, "tokens_done": 200},
+        "flash": {"weight": 1.0, "tokens_done": 200},
+    }}
+    bench.tenant_fairness_fields(out)
+    assert out["min_fair_share_ratio"] == pytest.approx(0.6667, abs=1e-3)
+    assert out["tenants"]["flash"]["fair_share_ratio"] == pytest.approx(2.0)
+    assert out["audit"] == "starved:gold"
+    assert "useful_tokens_per_sec" not in out      # no window measured
+
+
+def test_tenant_fairness_fields_skip_missing_sections():
+    out = {}
+    bench.tenant_fairness_fields(out)
+    assert "audit" not in out
+    out = {"tenants": {"gold": {"weight": 3.0, "tokens_done": 0}}}
+    bench.tenant_fairness_fields(out)                # leg produced no tokens
+    assert "audit" not in out
+
+
+def test_tenant_fairness_bench_wires_ledger_overload_and_fields():
+    """Source-level pin: bench_tenant_fairness must serve through a
+    TenantLedger-armed scheduler (qos=), run the flash-crowd aggressor at
+    4x the weighted tenants' client concurrency, drive closed-loop clients
+    against a stop event, and route through tenant_fairness_fields — the
+    full leg is a multi-second serving window, too heavy for this file."""
+    import inspect
+
+    src = inspect.getsource(bench.bench_tenant_fairness)
+    assert "tenant_fairness_fields(" in src
+    assert "TenantLedger(" in src
+    assert "qos=ledger" in src
+    assert '"flash": 16' in src
+    assert "threading.Event()" in src

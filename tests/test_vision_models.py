@@ -12,16 +12,21 @@ def _n_params(model):
 
 
 # small inputs where the architecture allows; inception needs 299, others 224.
-# The heaviest families (20-35s each on the tier-1 CPU budget, ~60% of this
-# file's wall) are marked slow: their architecture code paths still compile
-# in param_counts_sane, and the full slow-included suite runs them all.
+# The heaviest families (10-35s each on the tier-1 CPU budget, most of this
+# file's wall) are marked slow — LeNet stays the live conv-forward canary
+# and the full slow-included suite runs them all (ISSUE-17 wall paydown).
 @pytest.mark.parametrize("ctor, in_shape, n_out", [
     (lambda: models.LeNet(num_classes=10), (2, 1, 28, 28), 10),
-    (lambda: models.AlexNet(num_classes=7), (2, 3, 224, 224), 7),
-    (lambda: models.vgg11(num_classes=7), (2, 3, 224, 224), 7),
-    (lambda: models.vgg16(batch_norm=True, num_classes=7), (1, 3, 224, 224), 7),
-    (lambda: models.mobilenet_v1(scale=0.25, num_classes=7), (2, 3, 224, 224), 7),
-    (lambda: models.mobilenet_v2(scale=0.25, num_classes=7), (2, 3, 224, 224), 7),
+    pytest.param(lambda: models.AlexNet(num_classes=7),
+                 (2, 3, 224, 224), 7, marks=pytest.mark.slow),
+    pytest.param(lambda: models.vgg11(num_classes=7),
+                 (2, 3, 224, 224), 7, marks=pytest.mark.slow),
+    pytest.param(lambda: models.vgg16(batch_norm=True, num_classes=7),
+                 (1, 3, 224, 224), 7, marks=pytest.mark.slow),
+    pytest.param(lambda: models.mobilenet_v1(scale=0.25, num_classes=7),
+                 (2, 3, 224, 224), 7, marks=pytest.mark.slow),
+    pytest.param(lambda: models.mobilenet_v2(scale=0.25, num_classes=7),
+                 (2, 3, 224, 224), 7, marks=pytest.mark.slow),
     pytest.param(lambda: models.mobilenet_v3_small(num_classes=7),
                  (2, 3, 224, 224), 7, marks=pytest.mark.slow),
     pytest.param(lambda: models.mobilenet_v3_large(num_classes=7),
@@ -30,11 +35,16 @@ def _n_params(model):
                  (1, 3, 224, 224), 7, marks=pytest.mark.slow),
     pytest.param(lambda: models.inception_v3(num_classes=7),
                  (1, 3, 299, 299), 7, marks=pytest.mark.slow),
-    (lambda: models.squeezenet1_0(num_classes=7), (2, 3, 224, 224), 7),
-    (lambda: models.squeezenet1_1(num_classes=7), (2, 3, 224, 224), 7),
-    (lambda: models.shufflenet_v2_x0_25(num_classes=7), (2, 3, 224, 224), 7),
-    (lambda: models.shufflenet_v2_swish(num_classes=7), (1, 3, 224, 224), 7),
-    (lambda: models.resnext50_32x4d(num_classes=7), (1, 3, 224, 224), 7),
+    pytest.param(lambda: models.squeezenet1_0(num_classes=7),
+                 (2, 3, 224, 224), 7, marks=pytest.mark.slow),
+    pytest.param(lambda: models.squeezenet1_1(num_classes=7),
+                 (2, 3, 224, 224), 7, marks=pytest.mark.slow),
+    pytest.param(lambda: models.shufflenet_v2_x0_25(num_classes=7),
+                 (2, 3, 224, 224), 7, marks=pytest.mark.slow),
+    pytest.param(lambda: models.shufflenet_v2_swish(num_classes=7),
+                 (1, 3, 224, 224), 7, marks=pytest.mark.slow),
+    pytest.param(lambda: models.resnext50_32x4d(num_classes=7),
+                 (1, 3, 224, 224), 7, marks=pytest.mark.slow),
 ])
 def test_forward_shape(ctor, in_shape, n_out):
     model = ctor()
@@ -87,14 +97,16 @@ def _check_param_counts(names):
 
 
 def test_param_counts_sane():
-    _check_param_counts(("alexnet", "vgg16", "squeezenet1_0",
-                         "shufflenet_v2_x1_0", "resnext50_32x4d"))
+    # tier-1 canary kept to the two classic counts (~5s construction);
+    # ISSUE-17 wall paydown moved the rest to the slow-included suite
+    _check_param_counts(("alexnet", "vgg16"))
 
 
 @pytest.mark.slow
 def test_param_counts_sane_deep():
-    _check_param_counts(("mobilenet_v2", "densenet121",
-                         "inception_v3", "mobilenet_v3_large"))
+    _check_param_counts(("mobilenet_v2", "densenet121", "inception_v3",
+                         "mobilenet_v3_large", "squeezenet1_0",
+                         "shufflenet_v2_x1_0", "resnext50_32x4d"))
 
 
 # train-step smoke: LeNet stays tier-1 as the conv-train canary; the
