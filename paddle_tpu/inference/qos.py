@@ -296,6 +296,14 @@ class TenantLedger:
         with self._lock:
             return self._degraded
 
+    def fair_snapshot(self) -> dict:
+        """All tenants' fair-share clocks in one lock acquisition — the
+        flight recorder's per-tick capture (ISSUE-18): N fair_ratio()
+        calls per tick would pay N lock round-trips on the hot loop."""
+        with self._lock:
+            return {name: round(st.vservice, 6)
+                    for name, st in sorted(self._tenants.items())}
+
     def snapshot(self) -> dict:
         with self._lock:
             return {

@@ -66,9 +66,59 @@ from .speculative import make_drafter
 from .warmup import AOTWarmup
 from .warmup import notify as _recompile_notify
 
-__all__ = ["ContinuousGenerateBatchingPredictor"]
+__all__ = ["ContinuousGenerateBatchingPredictor", "phase_walls",
+           "attribution_shares"]
 
 _PREFILL, _DECODE = "prefill", "decode"
+
+
+def phase_walls(t0, t_admit, t_first, t_end, paused_s, paused_pre_s):
+    """Decompose one request's wall time into phase walls (seconds).
+
+    Pure function over the scheduler-clock stamps (ISSUE-18): acceptance
+    (t0), slot admission (t_admit), first generated token (t_first, None if
+    the request never produced one), terminal (t_end), plus total paused
+    seconds and the portion paused before the first token. Returns
+    (queue_s, prefill_s, paused_s, decode_s), each clamped >= 0:
+
+    * queue   — acceptance to slot admission (never admitted: the whole
+      life was queue wait).
+    * prefill — admission to first token, minus pre-first-token pause time
+      (no first token: everything after admission that wasn't a pause).
+    * paused  — preemption park time, charged to its OWN phase: a paused
+      sequence is neither prefilling nor decoding, and folding it into
+      either would misattribute a scheduling decision as model latency.
+    * decode  — first token to terminal, minus post-first-token pauses.
+    """
+    if t0 is None:
+        return (0.0, 0.0, 0.0, 0.0)
+    if t_admit is None:
+        return (max(0.0, t_end - t0), 0.0, 0.0, 0.0)
+    queue_s = max(0.0, t_admit - t0)
+    paused_total = max(0.0, float(paused_s))
+    paused_pre = min(paused_total, max(0.0, float(paused_pre_s)))
+    if t_first is None:
+        prefill_s = max(0.0, (t_end - t_admit) - paused_total)
+        return (queue_s, prefill_s, paused_total, 0.0)
+    prefill_s = max(0.0, (t_first - t_admit) - paused_pre)
+    decode_s = max(0.0, (t_end - t_first) - (paused_total - paused_pre))
+    return (queue_s, prefill_s, paused_total, decode_s)
+
+
+def attribution_shares(queue_s, prefill_s, paused_s, decode_s):
+    """Phase walls -> the terminal span's deadline-attribution tags.
+
+    Shares are normalized by the walls' own sum so they add to 1.0 by
+    construction (the property test's invariant); a zero-duration request
+    (door rejection, instant shed) is all queue — the phase it died in."""
+    total = queue_s + prefill_s + paused_s + decode_s
+    if total <= 0.0:
+        return {"queue_share": 1.0, "prefill_share": 0.0,
+                "paused_share": 0.0, "decode_share": 0.0}
+    return {"queue_share": round(queue_s / total, 6),
+            "prefill_share": round(prefill_s / total, 6),
+            "paused_share": round(paused_s / total, 6),
+            "decode_share": round(decode_s / total, 6)}
 
 
 class _SlotSeq:
@@ -78,7 +128,8 @@ class _SlotSeq:
                  "length", "generated", "table", "phase", "max_new", "order",
                  "temperature", "top_k", "spec", "prefix_hit", "digests",
                  "flushed", "adapter", "adapter_seed", "tenant", "priority",
-                 "qos_held")
+                 "qos_held", "t_admit", "t_first", "t_last", "t_pause",
+                 "paused_s", "paused_pre_s", "n_tok")
 
     def __init__(self, req, rid, ids, out_dtype, max_new, order):
         self.req = req
@@ -122,6 +173,18 @@ class _SlotSeq:
         self.tenant = None
         self.priority = 0
         self.qos_held = False
+        # phase attribution (ISSUE-18): scheduler-clock stamps — admission,
+        # first/last generated token — plus paused-time accounting (total
+        # seconds parked, the portion parked before the first token, and
+        # the open pause interval's start). Pause time charges a distinct
+        # `paused` phase: it is in neither TTFT's prefill nor TPOT's decode.
+        self.t_admit = None
+        self.t_first = None
+        self.t_last = None
+        self.t_pause = None
+        self.paused_s = 0.0
+        self.paused_pre_s = 0.0
+        self.n_tok = 0      # tokens actually sampled (EOS freeze excluded)
 
 
 class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
@@ -230,6 +293,28 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
                          Share ONE ledger across a fleet's replicas for
                          global buckets. Default None: untenanted traffic,
                          admission exactly as before.
+    slo                  ISSUE-18: an `observability.slo.SLOMonitor` —
+                         retirement feeds it per-tenant TTFT/TPOT samples
+                         and every terminal CAS feeds availability
+                         (good = the outcome's HTTP status < 500), and it
+                         exports `paddle_slo_error_budget_remaining{slo}` /
+                         `paddle_slo_burn_rate{slo,window}` on this
+                         scheduler's registry. With a flight recorder also
+                         installed, a policy's not-alerting -> alerting
+                         edge triggers an automatic ring dump (the breach
+                         ships its own postmortem). Default None: no SLO
+                         series (gauges exist iff a policy is installed).
+    flight_recorder      ISSUE-18: per-tick postmortem ring. True builds a
+                         default `observability.flightrecorder.
+                         FlightRecorder`; an int sets its capacity; pass an
+                         instance to share/configure. Each tick appends a
+                         snapshot (slot map with tenant/adapter/phase,
+                         batch widths, KV block accounting, paused/pending
+                         depths, ledger fair-ratios) — dumped on demand
+                         (`/debug/ticks`), on SLO alert, and by the chaos
+                         conftest fixture on test failure. Overhead is
+                         bench-gated <= 5% (slo_observability leg). Default
+                         False: no capture, tick loop byte-identical.
     """
 
     _component = "continuous"
@@ -254,7 +339,7 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
                  eos_token_id=None, max_defers=32, spec_k=0, drafter="ngram",
                  admit_policy="fifo", prefix_cache=False, warmup=False,
                  compile_cache_dir=None, hbm_budget=None, adapters=None,
-                 qos=None, **kwargs):
+                 qos=None, slo=None, flight_recorder=False, **kwargs):
         self.max_slots = int(max_slots)
         self.prefill_chunk = int(prefill_chunk)
         self.prefill_token_budget = int(prefill_token_budget
@@ -315,6 +400,24 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
         # batcher thread, scraped by gauges and pending() from others.
         self.qos = qos
         self._paused: collections.deque = collections.deque()
+        # ISSUE-18 SLO monitor + flight recorder: published before the tick
+        # thread starts (the tick loop's retirement paths and _flight_tick
+        # read them); the histograms/gauges bind after super() like every
+        # other metric family — no request can be in flight until __init__
+        # returns, so the late bind is unobservable
+        self.slo = slo
+        if flight_recorder is False or flight_recorder is None:
+            self.flight = None
+        elif flight_recorder is True:
+            from ..observability.flightrecorder import FlightRecorder
+            self.flight = FlightRecorder()
+        elif isinstance(flight_recorder, int):
+            from ..observability.flightrecorder import FlightRecorder
+            self.flight = FlightRecorder(capacity=flight_recorder)
+        else:
+            self.flight = flight_recorder
+        self._ttft_hist = None
+        self._tpot_hist = None
         # gauges scrape from other threads; witness-wrapped under chaos
         self._slot_lock = make_lock(
             "scheduler.ContinuousGenerateBatchingPredictor._slot_lock")
@@ -350,8 +453,8 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
             raise ValueError(f"max_seq_len {self.max_seq_len} exceeds the "
                              f"pool ({pool_tokens} tokens)")
         self.table_width = self.kv_cache.blocks_for(self.max_seq_len)
-        (self._spec_counter,
-         self._lora_requests_counter) = self._bind_scheduler_metrics()
+        (self._spec_counter, self._lora_requests_counter,
+         self._ttft_hist, self._tpot_hist) = self._bind_scheduler_metrics()
         if prefix_cache:
             from .prefix_cache import PrefixCache
             pc = (prefix_cache if isinstance(prefix_cache, PrefixCache)
@@ -517,7 +620,49 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
             "Cumulative speculative acceptance rate (accepted / drafted)",
             labels=("component",)).labels(self._component).set_function(
                 self._acceptance_rate)
-        return spec_counter, lora_counter
+        # ISSUE-18 phase-attributed latency: TTFT (acceptance -> first
+        # generated token) and TPOT (mean inter-token gap after the first,
+        # with pause time excluded — preemption is a scheduling decision,
+        # not model latency) per tenant. Untenanted traffic rides the
+        # "default" label, so the families are live on every continuous
+        # scheduler — retirement always observes them.
+        from ..observability.metrics import DEFAULT_LATENCY_BUCKETS
+        ttft_hist = reg.histogram(
+            "paddle_serving_ttft_seconds",
+            "Time to first generated token (acceptance -> first token) by "
+            "tenant; door-rejected requests are never sampled",
+            labels=("component", "tenant"), buckets=DEFAULT_LATENCY_BUCKETS)
+        tpot_hist = reg.histogram(
+            "paddle_serving_tpot_seconds",
+            "Mean time per output token after the first (paused time "
+            "excluded) by tenant",
+            labels=("component", "tenant"),
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, 0.25, 0.5, 1.0, 2.5))
+        # SLO gauges exist IFF a monitor is installed (exposition-lint
+        # contract); with a flight recorder too, an alert edge dumps the
+        # ring — the breach window's slot state survives the incident.
+        if self.slo is not None:
+            self.slo.bind_metrics(reg)
+            if self.flight is not None:
+                self.slo.on_alert(
+                    lambda p: self.flight.mark_alert(
+                        p.name, state=p.state(),
+                        burn_fast=round(p.burn_rate("fast"), 4),
+                        burn_slow=round(p.burn_rate("slow"), 4)))
+        if self.flight is not None:
+            occ = reg.gauge(
+                "paddle_flightrec_ticks",
+                "Flight-recorder ring state (occupancy = retained tick "
+                "snapshots, capacity = ring bound, dropped = evicted)",
+                labels=("component", "state"))
+            occ.labels(self._component, "occupancy").set_function(
+                lambda: float(self.flight.occupancy))
+            occ.labels(self._component, "capacity").set_function(
+                lambda: float(self.flight.capacity))
+            occ.labels(self._component, "dropped").set_function(
+                lambda: float(self.flight.dropped))
+        return spec_counter, lora_counter, ttft_hist, tpot_hist
 
     def _acceptance_rate(self):
         with self._slot_lock:
@@ -801,6 +946,7 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
                         self._retire_unserviceable()
                         self._prefill_tick()
                         self._decode_tick()
+                        self._flight_tick()     # ISSUE-18 postmortem ring
                     finally:
                         self._busy = False
                 except ThreadDeath:
@@ -963,6 +1109,7 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
                 tr.child("prefix_lookup", t_px, self.tracer.now_us(),
                          matched_blocks=len(hit.pairs),
                          hit_tokens=got)
+        seq.t_admit = self._clock()     # queue phase ends here (ISSUE-18)
         with self._slot_lock:
             self._slots[idx] = seq
         self.metrics.inc("admitted_seqs")
@@ -1093,6 +1240,7 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
         if s.qos_held:
             s.qos_held = False
             self.qos.release(s.tenant)
+        s.t_pause = self._clock()   # paused phase opens (ISSUE-18)
         self._paused.append(s)
         self.metrics.inc("preempted_seqs")
         tr = s.req.trace
@@ -1110,6 +1258,7 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
         if self.qos is not None and not s.qos_held:
             self.qos.acquire(s.tenant)
             s.qos_held = True
+        self._close_pause(s)
         with self._slot_lock:
             self._slots[idx] = s
         self.metrics.inc("resumed_seqs")
@@ -1185,6 +1334,124 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
         except KeyError:    # pragma: no cover - already evicted/released
             pass
 
+    # ------------------------------------------------ phase attribution (18)
+    def _close_pause(self, s):
+        """Fold an open pause interval into the sequence's paused-time
+        accounting (called on resume and on any terminal path that can
+        reach a still-parked sequence)."""
+        if s.t_pause is None:
+            return
+        dt = max(0.0, self._clock() - s.t_pause)
+        s.t_pause = None
+        s.paused_s += dt
+        if s.t_first is None:
+            s.paused_pre_s += dt
+
+    def _attribute(self, s, observe=True):
+        """Close out a sequence's phase accounting at its terminal: park
+        the {queue,prefill,paused,decode}_share dict on the request (the
+        terminal CAS tags the terminal span with it) and, when `observe`,
+        emit the per-tenant TTFT/TPOT samples and feed the SLO monitor.
+        Retry paths pass observe=False — a re-batched request must not
+        sample TTFT twice."""
+        self._close_pause(s)
+        req = s.req
+        walls = phase_walls(req.t0, s.t_admit, s.t_first, self._clock(),
+                            s.paused_s, s.paused_pre_s)
+        req.attribution = attribution_shares(*walls)
+        if not observe:
+            return
+        tenant = s.tenant if s.tenant is not None else "default"
+        if s.t_first is not None and req.t0 is not None:
+            ttft = max(0.0, s.t_first - req.t0)
+            self._ttft_hist.labels(self._component, tenant).observe(ttft)
+            if self.slo is not None:
+                self.slo.observe_ttft(ttft, tenant=tenant)
+            if s.n_tok > 1:
+                # decode wall with post-first-token pauses excluded: a
+                # preempted sequence's park time is a scheduling decision,
+                # never charged to TPOT
+                gap = max(0.0, (s.t_last - s.t_first)
+                          - (s.paused_s - s.paused_pre_s))
+                tpot = gap / (s.n_tok - 1)
+                self._tpot_hist.labels(self._component, tenant).observe(tpot)
+                if self.slo is not None:
+                    self.slo.observe_tpot(tpot, tenant=tenant)
+
+    def _terminal_good(self, error):
+        """Availability verdict of one terminal outcome: good iff the HTTP
+        status the error maps to is non-5xx (mirrors the server's
+        _fail_http taxonomy — a 400/429 is the client's problem, not an
+        availability hit)."""
+        if error is None:
+            return True
+        status = getattr(error, "status", None)     # Rejected carries one
+        if status is None:
+            if isinstance(error, TimeoutError):
+                status = 504
+            elif isinstance(error, CacheOutOfBlocks):
+                status = 503
+            elif isinstance(error, ValueError):
+                status = 400
+            else:
+                status = 500
+        return int(status) < 500
+
+    def _finish_req(self, req, result) -> bool:
+        won = super()._finish_req(req, result)
+        if won and self.slo is not None:
+            self.slo.observe_terminal(
+                True, tenant=getattr(req, "tenant", None))
+        return won
+
+    def _fail(self, req, error) -> bool:
+        won = super()._fail(req, error)
+        if won and self.slo is not None:
+            self.slo.observe_terminal(
+                self._terminal_good(error),
+                tenant=getattr(req, "tenant", None))
+        return won
+
+    def _flight_tick(self):
+        """One flight-recorder capture at the tick boundary (ISSUE-18): the
+        slot map with per-slot tenant/adapter/phase/progress, batch widths,
+        KV block accounting, paused/pending depths and the ledger's fair
+        ratios. Capture failures are swallowed — the postmortem ring must
+        never take the tick loop down."""
+        rec = self.flight
+        if rec is None:
+            return
+        try:
+            with self._slot_lock:
+                slots = [None if s is None else {
+                    "slot": i, "tenant": s.tenant, "adapter": int(s.adapter),
+                    "phase": s.phase, "plen": s.plen, "pos": int(s.pos),
+                    "generated": len(s.generated), "priority": s.priority,
+                } for i, s in enumerate(self._slots)]
+            live = [d for d in slots if d is not None]
+            kv = self.kv_cache
+            snap = {
+                "slots": slots,
+                "width": {
+                    "prefill": sum(1 for d in live
+                                   if d["phase"] == _PREFILL),
+                    "decode": sum(1 for d in live if d["phase"] == _DECODE),
+                    "free": self.max_slots - len(live),
+                },
+                "kv": {"in_use": int(kv.blocks_in_use),
+                       "free": int(kv.free_blocks),
+                       "evictable": int(kv.evictable_blocks)},
+                "paused": len(self._paused),
+                "pending": self._queue.qsize() + len(self._backlog),
+            }
+            if self.qos is not None:
+                snap["fair_ratios"] = self.qos.fair_snapshot()
+            rec.record(snap)
+        except ThreadDeath:
+            raise
+        except Exception:       # pragma: no cover - capture must not bite
+            pass
+
     def _retire_ok(self, i, s):
         out = np.concatenate(
             [s.ids, np.asarray(s.generated[:s.max_new], np.int64)])
@@ -1198,6 +1465,7 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
             self.kv_cache.set_length(s.rid, s.plen + s.max_new)
         except (KeyError, ValueError):  # pragma: no cover - audit-only state
             pass
+        self._attribute(s)      # ISSUE-18: shares + TTFT/TPOT samples
         self._finish_req(s.req, out.astype(s.out_dtype))
         if self.qos is not None and s.tenant is not None:
             # useful tokens by tenant (ISSUE-17): the fairness bench's
@@ -1224,6 +1492,7 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
                 self.metrics.inc("retired_seqs")
                 continue
             if req.deadline is not None and req.deadline.expired():
+                self._attribute(s)      # where the deadline actually went
                 if self._fail(req, DeadlineExceeded(
                         "deadline expired mid-decode (continuous tick)")):
                     self.metrics.inc("expired_in_flight")
@@ -1241,6 +1510,7 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
                 self._evict_paused(s)
                 self.metrics.inc("retired_seqs")
             elif req.deadline is not None and req.deadline.expired():
+                self._attribute(s)      # paused_share carries the park time
                 if self._fail(req, DeadlineExceeded(
                         "deadline expired while preempted (paused)")):
                     self.metrics.inc("expired_in_flight")
@@ -1252,14 +1522,25 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
         retired. EOS freezes the remainder (parity with the in-scan
         sampler's finished mask, which resets per launch)."""
         eos = self.eos_token_id
+        absorbed = 0
         for t in toks:
             if len(s.generated) >= s.max_new:
                 break
             t = int(t)
             s.generated.append(t)
+            absorbed += 1
             if eos is not None and t == eos:
                 s.generated.extend([eos] * (s.max_new - len(s.generated)))
                 break
+        if absorbed:
+            # ISSUE-18: first/last token stamps (tick-boundary resolution —
+            # TPOT is the mean inter-token gap, and a tick absorbs
+            # decode_steps tokens at once, so per-token jitter averages out)
+            now = self._clock()
+            if s.t_first is None:
+                s.t_first = now
+            s.t_last = now
+            s.n_tok += absorbed
         self._flush_stream(s)
         if len(s.generated) >= s.max_new:
             self._retire_ok(i, s)
@@ -1291,6 +1572,10 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
         self._span_each(reqs, span_name, t0, self.tracer.now_us(),
                         error=repr(error))
         for i, s in picks:
+            # shares only (observe=False): a retry re-enters the queue and
+            # must not sample TTFT twice — a retried-then-served request
+            # samples once, at its eventual retirement
+            self._attribute(s, observe=False)
             self._evict_slot(i, s)
             self._fail_or_retry(s.req, error)
 

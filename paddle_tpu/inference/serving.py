@@ -92,8 +92,8 @@ class _Request:
 
     __slots__ = ("arrays", "event", "result", "error", "deadline", "retries",
                  "defers", "t0", "trace", "enq_us", "max_new", "temperature",
-                 "top_k", "spec", "adapter", "tenant", "on_tokens", "_lock",
-                 "_state")
+                 "top_k", "spec", "adapter", "tenant", "on_tokens",
+                 "attribution", "_lock", "_state")
 
     def __init__(self, arrays, deadline=None, trace=None):
         self.arrays = arrays
@@ -116,6 +116,11 @@ class _Request:
         # enqueue, called by the scheduler's tick loop with each newly
         # absorbed token chunk; None = buffered (non-streaming) request
         self.on_tokens = None
+        # ISSUE-18 deadline attribution: the continuous scheduler computes
+        # {queue,prefill,paused,decode}_share at retirement and parks the
+        # dict here so the terminal CAS (whichever leg wins) tags the
+        # terminal span with where the request's wall time actually went
+        self.attribution = None
         self._lock = make_lock("serving._Request._lock")
         self._state = _PENDING
 
@@ -208,6 +213,15 @@ class BatchingPredictor:
         self.tracer = tracer if tracer is not None else Tracer()
         self.metrics = ServingMetrics(registry=registry,
                                       component=self._component)
+        # ISSUE-18: span loss is invisible until it bites a postmortem —
+        # surface the tracer ring's eviction count on the scrape (function-
+        # backed: the tracer already maintains the number; no double books)
+        self.metrics.registry.counter(
+            "paddle_trace_dropped_spans_total",
+            "Spans evicted from the tracer ring buffer (raise Tracer "
+            "capacity= if this grows during an incident window)",
+            labels=("component",)).labels(self._component).set_function(
+                lambda: float(self.tracer.dropped))
         self.admission = admission if admission is not None \
             else AdmissionController()
         self.breaker = breaker if breaker is not None else CircuitBreaker(
@@ -292,8 +306,20 @@ class BatchingPredictor:
         except Rejected as e:
             self.metrics.inc("rejected_busy" if isinstance(e, ServerBusy)
                              else "rejected_unavailable")
+            # ISSUE-18 availability SLO: a door rejection is terminal too —
+            # 429 is the client's backpressure (good), 503 is ours (bad)
+            slo = getattr(self, "slo", None)
+            if slo is not None:
+                slo.observe_terminal(e.status < 500,
+                                     tenant=getattr(req, "tenant", None))
             tr.child("admission", t_adm, tr.now_us(), error=repr(e))
-            tr.finish("rejected", status=e.status, error=repr(e))
+            # door rejection (ISSUE-18): 100% of the request's life was
+            # queue-side — attribute it as such; rejected requests never
+            # enter the TTFT histogram (a zero-valued sample would drag
+            # p50 toward the shed path instead of measuring served ones)
+            tr.finish("rejected", status=e.status, error=repr(e),
+                      queue_share=1.0, prefill_share=0.0,
+                      paused_share=0.0, decode_share=0.0)
             raise
         except ValueError as e:  # malformed/oversized: no retry can fix it
             self.metrics.inc("rejected_invalid")
@@ -344,7 +370,8 @@ class BatchingPredictor:
             self.metrics.inc("completed")
             self._observe(req)
             if req.trace is not None:
-                req.trace.finish("result", cas="result")
+                req.trace.finish("result", cas="result",
+                                 **(req.attribution or {}))
             return True
         # computed a result nobody will read (client cancelled mid-batch)
         self.metrics.inc("wasted_results")
@@ -369,7 +396,8 @@ class BatchingPredictor:
                 terminal = "shed"
         self._observe(req)
         if req.trace is not None:
-            req.trace.finish(terminal, cas=terminal, error=repr(error))
+            req.trace.finish(terminal, cas=terminal, error=repr(error),
+                             **(req.attribution or {}))
         return True
 
     def _fail_or_retry(self, req, error):
@@ -822,7 +850,8 @@ class InferenceServer:
             def _metric_path(self):
                 p = self.path.split("?", 1)[0]
                 return p if p in ("/health", "/readyz", "/metrics",
-                                  "/predict", "/generate") else "other"
+                                  "/predict", "/generate", "/slo",
+                                  "/debug/ticks") else "other"
 
             def _reply(self, status, body, headers=()):
                 # count BEFORE writing: a client that saw the response must
@@ -998,10 +1027,88 @@ class InferenceServer:
                         if hasattr(outer.generator, "replica_states"):
                             snap["replicas"] = \
                                 outer.generator.replica_states()
+                    # ISSUE-18: span loss + postmortem-ring occupancy in
+                    # the JSON snapshot — the numbers an operator checks
+                    # FIRST when a trace or dump comes back thinner than
+                    # the incident it should cover
+                    tracers = {}
+                    for wname, w in (("batcher", outer.batcher),
+                                     ("generator", outer.generator)):
+                        t = getattr(w, "tracer", None)
+                        if t is not None:
+                            tracers[wname] = {
+                                "dropped": t.dropped,
+                                "recorded_spans": len(t.spans()),
+                            }
+                    if tracers:
+                        snap["tracer"] = tracers
+                    fl = getattr(outer.generator, "flight", None)
+                    if fl is not None:
+                        snap["flight_recorder"] = {
+                            "occupancy": fl.occupancy,
+                            "capacity": fl.capacity,
+                            "dropped": fl.dropped,
+                        }
                     self._reply(200, json.dumps(snap).encode(),
                                 [("Content-Type", "application/json")])
+                elif path == "/slo":
+                    # ISSUE-18: burn-rate/budget JSON for the SLO monitor
+                    # (404 when none installed — same absent-iff-off
+                    # contract as the paddle_slo_* gauges)
+                    import json
+
+                    mon = self._find_slo()
+                    if mon is None:
+                        self._reply(404, b"no SLO policy installed")
+                    else:
+                        self._reply(200, json.dumps(mon.snapshot()).encode(),
+                                    [("Content-Type", "application/json")])
+                elif path == "/debug/ticks":
+                    # ISSUE-18: flight-recorder dump on demand; ?last=N
+                    # bounds the artifact to the newest N ticks
+                    import json
+
+                    last = None
+                    if "last=" in query:
+                        try:
+                            last = int(query.split("last=", 1)[1]
+                                       .split("&", 1)[0])
+                        except ValueError:
+                            self._reply(400, b"malformed last= (need int)")
+                            return
+                    dumps = self._find_flight_dumps(last)
+                    if not dumps:
+                        self._reply(404, b"no flight recorder installed")
+                    else:
+                        self._reply(200, json.dumps(dumps).encode(),
+                                    [("Content-Type", "application/json")])
                 else:
                     self._reply(404, b"")
+
+            def _find_slo(self):
+                """The generator's SLOMonitor — fleet-aware: replicas
+                usually share one monitor; the first one found wins."""
+                mon = getattr(outer.generator, "slo", None)
+                if mon is None and hasattr(outer.generator, "_snapshot"):
+                    for rep in outer.generator._snapshot():
+                        mon = getattr(rep.predictor, "slo", None)
+                        if mon is not None:
+                            break
+                return mon
+
+            def _find_flight_dumps(self, last):
+                """Flight-recorder dumps keyed by recorder name — one entry
+                for a plain scheduler, one per replica for a fleet."""
+                fl = getattr(outer.generator, "flight", None)
+                if fl is not None:
+                    return {fl.name: fl.dump(last=last)}
+                dumps = {}
+                if hasattr(outer.generator, "_snapshot"):
+                    for rep in outer.generator._snapshot():
+                        f = getattr(rep.predictor, "flight", None)
+                        if f is not None:
+                            dumps[f.name] = f.dump(last=last)
+                return dumps
 
             def _wants_stream(self):
                 """SSE opt-in: `X-Stream: sse`, or Accept: text/event-stream
@@ -1429,8 +1536,20 @@ class ReplicaFleet:
         except Rejected as e:
             self.metrics.inc("rejected_busy" if isinstance(e, ServerBusy)
                              else "rejected_unavailable")
+            # ISSUE-18 availability SLO: a door rejection is terminal too —
+            # 429 is the client's backpressure (good), 503 is ours (bad)
+            slo = getattr(self, "slo", None)
+            if slo is not None:
+                slo.observe_terminal(e.status < 500,
+                                     tenant=getattr(req, "tenant", None))
             tr.child("admission", t_adm, tr.now_us(), error=repr(e))
-            tr.finish("rejected", status=e.status, error=repr(e))
+            # door rejection (ISSUE-18): 100% of the request's life was
+            # queue-side — attribute it as such; rejected requests never
+            # enter the TTFT histogram (a zero-valued sample would drag
+            # p50 toward the shed path instead of measuring served ones)
+            tr.finish("rejected", status=e.status, error=repr(e),
+                      queue_share=1.0, prefill_share=0.0,
+                      paused_share=0.0, decode_share=0.0)
             raise
         tr.child("admission", t_adm, tr.now_us())
         self.metrics.inc("accepted")

@@ -19,13 +19,29 @@ Three parts, one timebase:
   registry/tracer primitives (and the same perf_counter timebase, so
   ``export_joined_chrome`` shows step phases against profiler events).
 
+Serving SLOs ride on the same registry: ``slo`` evaluates declarative
+objectives (TTFT/TPOT percentiles, availability) over injectable-clock
+rolling windows with SRE-workbook multi-window burn-rate alerting, and
+``flightrecorder`` keeps a bounded ring of per-tick scheduler snapshots
+dumped on demand (``/debug/ticks``), on alert, or on chaos-test failure.
+
 Span taxonomy, metric names and the scrape/join recipes live in
 docs/OBSERVABILITY.md.
 """
+from .flightrecorder import (  # noqa: F401
+    FlightRecorder,
+    dump_all,
+    live_recorders,
+)
 from .metrics import (  # noqa: F401
     DEFAULT_LATENCY_BUCKETS,
     MetricsRegistry,
     render_prometheus,
+)
+from .slo import (  # noqa: F401
+    SLOMonitor,
+    SLOPolicy,
+    make_policies,
 )
 from .trace import (  # noqa: F401
     RequestTrace,

@@ -47,6 +47,11 @@ BUDGET_EXEMPT = {
                "param-count parity stays the tier-1 vision-family canary"),
     "tests/test_vision_models.py::test_forward_shape":
         (12.1, "parametrized forward across the zoo; worst param ~12s"),
+    "tests/test_vision_models.py::test_train_step":
+        (16.1, "shallow-zoo train-step parametrization; crept over the "
+               "line on the PR 18 measured run (machine noise on the "
+               "1-core box) — the deep archs are already slow-marked, "
+               "these are the tier-1 vision train canary"),
     "tests/test_elastic.py::test_kill_mid_step_resumes_with_loss_continuity":
         (17.2, "multi-process kill/resume soak; the restart variants are "
                "already slow-marked (PR 4), these two are the tier-1 core"),
@@ -186,6 +191,44 @@ def _chaos_compile_sentinel(request):
     if s.violations:
         pytest.fail("compile sentinel observed post-ready cold builds "
                     f"(component, program): {list(s.violations)}")
+
+
+# ISSUE-18: a failed chaos leg ships its own postmortem — the flight
+# recorder's per-tick ring (every live recorder, via the module-level weak
+# registry) dumps to a JSON artifact when a chaos-marked test's call phase
+# fails. The hookwrapper below exposes the call-phase outcome to fixtures
+# (the standard pytest recipe; there is no other makereport hook here).
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    outcome = yield
+    rep = outcome.get_result()
+    setattr(item, f"rep_{rep.when}", rep)
+
+
+@pytest.fixture(autouse=True)
+def _chaos_flight_dump(request, tmp_path):
+    if "chaos" not in request.keywords:
+        yield
+        return
+    yield
+    rep = getattr(request.node, "rep_call", None)
+    if rep is None or not rep.failed:
+        return
+    from paddle_tpu.observability import flightrecorder
+
+    dumps = flightrecorder.dump_all(last=64)
+    dumps = {k: v for k, v in dumps.items() if v["recorded"]}
+    if not dumps:
+        return
+    import json
+
+    path = tmp_path / "flight_recorder_dump.json"
+    path.write_text(json.dumps(dumps, sort_keys=True))
+    print(f"\n[flightrecorder] chaos failure postmortem: {path} "
+          f"({sum(d['occupancy'] for d in dumps.values())} ticks from "
+          f"{len(dumps)} recorder(s))")
 
 
 # serving tests spin up batcher/server threads; one that leaks a NON-daemon

@@ -559,6 +559,16 @@ def test_metrics_exposition_lint_and_conservation(small_gpt):
         # HTTP layer counted every response we made
         assert series2[("paddle_http_responses_total",
                         'path="/generate",status="200"')] == 2
+
+        # ISSUE-18 absent-iff-off contract: no SLOMonitor / flight recorder
+        # wired here, so none of their gauges may render (a dead gauge is
+        # noise); the tracer-drop counter, by contrast, is always-on
+        assert not any(n.startswith("paddle_slo_") for n in types2)
+        assert "paddle_flightrec_ticks" not in types2
+        assert "paddle_trace_dropped_spans_total" in types2
+        for (name, labels), v in series2.items():
+            if name == "paddle_trace_dropped_spans_total":
+                assert 'component="' in labels and v == 0.0
     finally:
         srv.stop(drain_timeout=5)
 

@@ -1,0 +1,574 @@
+"""ISSUE-18 serving SLOs: phase attribution, burn-rate monitor, flight
+recorder.
+
+Pure legs drive SLOPolicy/SLOMonitor through the SRE multi-window lifecycle
+on a fake clock (budget-exhaust -> fast-window alert -> slow-window confirm
+-> recovery) and pin the attribution-share invariant
+(queue + prefill + paused + decode == 1) by property sweep. Live legs boot
+the continuous scheduler with a QoS ledger, an SLOMonitor and a flight
+recorder and check the per-tenant TTFT/TPOT series, the terminal-span share
+tags, the /slo and /debug/ticks endpoints, and the chaos-forced breach ->
+alert-mark -> postmortem-dump path end to end.
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.faults import FaultInjector
+from paddle_tpu.inference.qos import TenantLedger
+from paddle_tpu.inference.resilience import AdmissionController, ServerBusy
+from paddle_tpu.inference.scheduler import (
+    ContinuousGenerateBatchingPredictor,
+    attribution_shares,
+    phase_walls,
+)
+from paddle_tpu.inference.serving import InferenceServer
+from paddle_tpu.observability import (
+    FlightRecorder,
+    SLOMonitor,
+    SLOPolicy,
+    dump_all,
+    live_recorders,
+    make_policies,
+)
+from paddle_tpu.observability.metrics import (
+    MetricsRegistry,
+    render_prometheus,
+)
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+
+
+@pytest.fixture(scope="module")
+def small_gpt():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    with paddle.utils.unique_name.guard():
+        paddle.seed(11)
+        m = GPTForCausalLM(GPTConfig(vocab_size=160, hidden_size=64,
+                                     num_layers=2, num_heads=4,
+                                     num_kv_heads=2, max_position=96,
+                                     dropout=0.0))
+    m.eval()
+    return m
+
+
+def _make(m, **kw):
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("prefill_chunk", 4)
+    kw.setdefault("decode_steps", 2)
+    kw.setdefault("max_new_tokens", 6)
+    kw.setdefault("decode_kernel", "xla")
+    kw.setdefault("block_size", 8)
+    kw.setdefault("num_blocks", 32)
+    kw.setdefault("max_seq_len", 40)
+    return ContinuousGenerateBatchingPredictor(m, **kw)
+
+
+def _get(base, path, headers=None):
+    req = urllib.request.Request(base + path, headers=headers or {})
+    try:
+        r = urllib.request.urlopen(req, timeout=10)
+        return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def _post_ids(base, path, ids):
+    import io
+
+    buf = io.BytesIO()
+    np.savez(buf, ids=ids)
+    req = urllib.request.Request(base + path, data=buf.getvalue())
+    try:
+        r = urllib.request.urlopen(req, timeout=60)
+        return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+# ------------------------------------------------------- phase attribution
+def test_phase_walls_cases():
+    # never accepted: nothing to attribute
+    assert phase_walls(None, None, None, 10.0, 0.0, 0.0) == (0, 0, 0, 0)
+    # never admitted: the whole life was queue wait
+    assert phase_walls(1.0, None, None, 4.0, 0.0, 0.0) == (3.0, 0.0, 0.0,
+                                                           0.0)
+    # admitted, no first token: everything post-admission minus pauses is
+    # prefill, pause charged to its own phase
+    q, pre, pau, dec = phase_walls(1.0, 2.0, None, 10.0, 3.0, 3.0)
+    assert (q, pre, pau, dec) == (1.0, 5.0, 3.0, 0.0)
+    # full lifecycle with a pre-first-token pause and a decode-time pause
+    q, pre, pau, dec = phase_walls(0.0, 1.0, 5.0, 11.0, 3.0, 2.0)
+    assert q == 1.0
+    assert pre == pytest.approx(2.0)    # (5-1) minus 2s pre-token pause
+    assert pau == 3.0
+    assert dec == pytest.approx(5.0)    # (11-5) minus 1s post-token pause
+    # clock clamp: a skewed stamp never yields a negative wall
+    q, pre, pau, dec = phase_walls(5.0, 4.0, 3.0, 2.0, 0.0, 0.0)
+    assert q == pre == dec == 0.0 and pau == 0.0
+
+
+def test_attribution_shares_sum_to_one_property():
+    """Satellite 3: queue+prefill+paused+decode == 1 on every attribution,
+    across a seeded sweep of random (and degenerate) timelines."""
+    rng = np.random.default_rng(42)
+    for _ in range(300):
+        t0 = float(rng.uniform(0, 100))
+        t_admit = t0 + float(rng.uniform(0, 5))
+        t_first = (None if rng.uniform() < 0.2
+                   else t_admit + float(rng.uniform(0, 5)))
+        t_end = (t_admit if t_first is None else t_first) \
+            + float(rng.uniform(0, 5))
+        paused = float(rng.uniform(0, 3))
+        paused_pre = float(rng.uniform(0, paused)) if paused else 0.0
+        walls = phase_walls(t0, t_admit, t_first, t_end, paused, paused_pre)
+        assert all(w >= 0.0 for w in walls)
+        shares = attribution_shares(*walls)
+        assert set(shares) == {"queue_share", "prefill_share",
+                               "paused_share", "decode_share"}
+        assert all(0.0 <= v <= 1.0 for v in shares.values())
+        assert sum(shares.values()) == pytest.approx(1.0, abs=5e-6)
+    # zero-duration life (door rejection): all queue, by definition
+    assert attribution_shares(0.0, 0.0, 0.0, 0.0) == {
+        "queue_share": 1.0, "prefill_share": 0.0,
+        "paused_share": 0.0, "decode_share": 0.0}
+
+
+# ------------------------------------------------------------ SLOPolicy math
+def test_slo_policy_burn_rate_lifecycle_on_fake_clock():
+    """Satellite 3: budget-exhaust -> fast-window alert -> slow-window
+    confirm -> recovery, all on a fake clock (no sleeping)."""
+    clk = FakeClock(1000.0)
+    p = SLOPolicy("ttft_p95_ms", "ttft", target=0.95, threshold_ms=100.0,
+                  fast_window_s=60.0, slow_window_s=300.0,
+                  burn_threshold=2.0, clock=clk)
+    # idle service burns nothing
+    assert p.bad_fraction(60.0) == 0.0
+    assert p.state() == "ok" and p.error_budget_remaining() == 1.0
+
+    # healthy traffic across the whole budget window
+    for _ in range(100):
+        clk.tick(2.9)
+        p.observe(0.010)            # 10ms <= 100ms -> good
+    assert p.state() == "ok"
+    assert p.burn_rate("fast") == 0.0 and p.burn_rate("slow") == 0.0
+
+    # a blip: bads land in the fast window, budget barely dented ->
+    # fast_burn (page nobody)
+    for _ in range(5):
+        clk.tick(1.0)
+        p.observe(0.500)            # 500ms -> bad
+    assert p.burn_rate("fast") >= 2.0
+    assert p.burn_rate("slow") < 2.0
+    assert p.state() == "fast_burn"
+    assert 0.0 < p.error_budget_remaining() < 1.0
+
+    # sustained: the slow window heats too -> alerting, budget exhausted
+    for _ in range(30):
+        clk.tick(1.0)
+        p.observe(0.500)
+    assert p.burn_rate("slow") >= 2.0
+    assert p.state() == "alerting"
+    assert p.error_budget_remaining() == 0.0
+
+    # recovery: the windows roll past the incident
+    clk.tick(400.0)
+    p.observe(0.010)
+    assert p.state() == "ok"
+    assert p.burn_rate("slow") == 0.0
+    assert p.error_budget_remaining() == 1.0
+    # lifetime counters survive the window roll (snapshot bookkeeping)
+    snap = p.snapshot()
+    assert snap["total_events"] == 136 and snap["bad_events"] == 35
+    assert snap["state"] == "ok" and snap["kind"] == "ttft"
+
+
+def test_make_policies_parsing_and_validation():
+    ps = make_policies({"ttft_p95_ms": 200.0, "tpot_p99.9_ms": 50.0,
+                        "availability": 0.999})
+    by = {p.name: p for p in ps}
+    assert by["ttft_p95_ms"].kind == "ttft"
+    assert by["ttft_p95_ms"].target == pytest.approx(0.95)
+    assert by["ttft_p95_ms"].threshold_ms == 200.0
+    assert by["tpot_p99.9_ms"].kind == "tpot"
+    assert by["tpot_p99.9_ms"].target == pytest.approx(0.999)
+    assert by["availability"].kind == "availability"
+    assert by["availability"].target == 0.999
+    assert by["availability"].threshold_ms is None
+
+    with pytest.raises(ValueError):
+        make_policies({"latency_p95_ms": 200.0})    # unknown kind
+    with pytest.raises(ValueError):
+        make_policies({"ttft_p0_ms": 200.0})        # percentile out of range
+    with pytest.raises(ValueError):
+        SLOPolicy("x", "throughput", target=0.9)    # unknown kind
+    with pytest.raises(ValueError):
+        SLOPolicy("x", "availability", target=1.0)  # no budget to burn
+    with pytest.raises(ValueError):
+        SLOPolicy("x", "ttft", target=0.95)         # latency needs threshold
+    with pytest.raises(ValueError):
+        SLOPolicy("x", "availability", target=0.9,
+                  fast_window_s=60.0, slow_window_s=60.0)  # fast !< slow
+    with pytest.raises(ValueError):
+        SLOMonitor()                                 # no objectives at all
+    p = SLOPolicy("dup", "availability", target=0.9)
+    with pytest.raises(ValueError):
+        SLOMonitor(policies=[p, p])                  # duplicate names
+
+
+def test_slo_monitor_alert_edge_fires_once_and_rearms():
+    """The on_alert contract: exactly one firing per not-alerting ->
+    alerting edge, re-armed by recovery; a broken callback never blocks
+    the next one (isolation)."""
+    clk = FakeClock()
+    mon = SLOMonitor({"availability": 0.9}, fast_window_s=10.0,
+                     slow_window_s=50.0, burn_threshold=2.0, clock=clk)
+    fired = []
+
+    @mon.on_alert
+    def _broken(policy):            # isolation: must not eat later cbs
+        raise RuntimeError("alert hook crashed")
+
+    mon.on_alert(lambda policy: fired.append(policy.name))
+
+    for _ in range(8):
+        clk.tick(1.0)
+        mon.observe_terminal(True)
+    assert fired == [] and mon.alerting() == []
+
+    for _ in range(4):
+        clk.tick(1.0)
+        mon.observe_terminal(False)
+    assert mon.alerting() == ["availability"]
+    assert fired == ["availability"]          # the edge, once
+
+    clk.tick(1.0)
+    mon.observe_terminal(False)               # still alerting: no re-fire
+    assert fired == ["availability"]
+
+    # recovery re-arms the edge
+    clk.tick(60.0)
+    mon.observe_terminal(True)
+    assert mon.alerting() == []
+
+    for _ in range(4):
+        clk.tick(1.0)
+        mon.observe_terminal(False, tenant="gold")
+    assert fired == ["availability", "availability"]
+
+    snap = mon.snapshot()
+    assert snap["alerting"] == ["availability"]
+    assert set(snap["policies"]) == {"availability"}
+    assert snap["recent_bad"][-1]["tenant"] == "gold"
+    assert snap["recent_bad"][-1]["kind"] == "availability"
+
+
+def test_slo_monitor_bind_metrics_gauges_and_idempotency():
+    """Satellite 5: paddle_slo_* gauges present IFF a monitor is bound, one
+    series per (slo) / (slo, window), double-bind renders cleanly."""
+    reg = MetricsRegistry()
+    clk = FakeClock()
+    mon = SLOMonitor({"ttft_p95_ms": 100.0, "availability": 0.99},
+                     fast_window_s=10.0, slow_window_s=50.0, clock=clk)
+    mon.bind_metrics(reg)
+    mon.bind_metrics(reg)   # idempotent: duplicate series would raise below
+    text = render_prometheus(reg)
+    assert 'paddle_slo_error_budget_remaining{slo="ttft_p95_ms"} 1' in text
+    assert 'paddle_slo_burn_rate{slo="availability",window="fast"} 0' in text
+    assert 'paddle_slo_burn_rate{slo="availability",window="slow"} 0' in text
+
+    for _ in range(5):
+        clk.tick(1.0)
+        mon.observe_ttft(0.500)     # all bad against 100ms
+    text = render_prometheus(reg)
+    assert 'paddle_slo_error_budget_remaining{slo="ttft_p95_ms"} 0' in text
+    # availability policy untouched by ttft feeds
+    assert 'paddle_slo_error_budget_remaining{slo="availability"} 1' in text
+
+
+# ---------------------------------------------------------- flight recorder
+def test_flight_recorder_ring_bounds_dump_and_registry():
+    clk = FakeClock()
+    rec = FlightRecorder(capacity=4, clock=clk, name="ringtest")
+    try:
+        for i in range(10):
+            clk.tick(0.5)
+            rec.record({"i": i})
+        assert rec.capacity == 4
+        assert rec.occupancy == 4
+        assert rec.dropped == 6
+
+        d = rec.dump()
+        assert d["name"] == "ringtest"
+        assert d["recorded"] == 10 and d["dropped"] == 6
+        assert [t["tick"] for t in d["ticks"]] == [7, 8, 9, 10]
+        assert [t["i"] for t in d["ticks"]] == [6, 7, 8, 9]
+        assert all(t["t"] > 0 for t in d["ticks"])
+
+        d2 = rec.dump(last=2)
+        assert [t["tick"] for t in d2["ticks"]] == [9, 10]
+        assert d2["dropped"] == 6       # last= bounds the artifact, not
+        assert d2["recorded"] == 10     # the ring accounting
+
+        rec.mark_alert("ttft_p95_ms", state="alerting")
+        d3 = json.loads(rec.dump_json(last=1))
+        assert d3["alerts"][0]["slo"] == "ttft_p95_ms"
+        assert d3["alerts"][0]["at_tick"] == 10
+        assert d3["alerts"][0]["state"] == "alerting"
+
+        # module weak registry: the chaos conftest fixture's entrypoint
+        assert any(r is rec for r in live_recorders())
+        assert dump_all(last=1)["ringtest"]["recorded"] == 10
+
+        rec.clear()
+        assert rec.occupancy == 0 and rec.dump()["alerts"] == []
+    finally:
+        del rec     # drop the weak registry entry eagerly
+
+    with pytest.raises(ValueError):
+        FlightRecorder(capacity=0)
+
+
+# ----------------------------------------------------------------- live legs
+def test_serving_per_tenant_ttft_tpot_and_share_tags(small_gpt):
+    """Tentpole, live: retirement emits per-tenant TTFT/TPOT histogram
+    samples, the terminal span carries normalized share tags, the SLO
+    monitor sees every stream, and the flight ring fills with slot maps
+    including the ledger's fair ratios."""
+    led = TenantLedger()
+    led.register("gold", weight=2.0)
+    led.register("bronze", weight=1.0)
+    mon = SLOMonitor({"ttft_p95_ms": 60000.0, "tpot_p99_ms": 60000.0,
+                      "availability": 0.99})
+    gp = _make(small_gpt, qos=led, slo=mon, flight_recorder=True)
+    try:
+        rng = np.random.default_rng(5)
+        plens = [3, 5, 7, 4]
+        tenants = ["gold", "bronze", "gold", "bronze"]
+        prompts = [rng.integers(0, 160, n).astype("int64") for n in plens]
+        results = {}
+        ts = [threading.Thread(
+            target=lambda i=i: results.update(
+                {i: gp.infer(prompts[i], timeout=300, tenant=tenants[i])}))
+            for i in range(len(prompts))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=300)
+        assert len(results) == len(prompts)
+
+        # terminal "request" spans carry the four share tags, summing to 1
+        tagged = [s.tags for s in gp.tracer.spans()
+                  if s.name == "request" and "queue_share" in s.tags]
+        assert len(tagged) == len(prompts)
+        for tags in tagged:
+            total = (tags["queue_share"] + tags["prefill_share"]
+                     + tags["paused_share"] + tags["decode_share"])
+            assert total == pytest.approx(1.0, abs=5e-6)
+            assert tags["paused_share"] == 0.0   # nothing preempted here
+
+        text = render_prometheus(gp.metrics.registry)
+        for tenant in ("gold", "bronze"):
+            assert (f'paddle_serving_ttft_seconds_count{{'
+                    f'component="continuous",tenant="{tenant}"}} 2') in text
+            # max_new 6 > 1 token: every stream also samples TPOT
+            assert (f'paddle_serving_tpot_seconds_count{{'
+                    f'component="continuous",tenant="{tenant}"}} 2') in text
+        # label hygiene: every ttft/tpot series is tenant-labelled with a
+        # registered name (never an empty label)
+        for line in text.splitlines():
+            if line.startswith(("paddle_serving_ttft_seconds",
+                                "paddle_serving_tpot_seconds")):
+                assert 'tenant="gold"' in line or 'tenant="bronze"' in line
+        # satellite 1 + gauge contract: dropped-spans counter and the
+        # flight-ring gauges render alongside the SLO gauges
+        assert 'paddle_trace_dropped_spans_total{component="continuous"} 0' \
+            in text
+        assert 'paddle_slo_error_budget_remaining{slo="ttft_p95_ms"} 1' \
+            in text
+        assert 'paddle_flightrec_ticks{component="continuous",' \
+            'state="capacity"} 512' in text
+
+        snap = mon.snapshot()
+        assert snap["alerting"] == []
+        assert snap["policies"]["availability"]["total_events"] == 4
+        assert snap["policies"]["ttft_p95_ms"]["total_events"] == 4
+        assert snap["policies"]["tpot_p99_ms"]["total_events"] == 4
+        assert snap["policies"]["availability"]["bad_events"] == 0
+
+        # the ring filled at tick boundaries (the final tick may land just
+        # after the last client wakes: poll briefly)
+        deadline = time.monotonic() + 5.0
+        while gp.flight.occupancy == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        d = gp.flight.dump()
+        assert d["recorded"] > 0
+        tick = d["ticks"][-1]
+        assert {"tick", "t", "slots", "width", "kv", "paused",
+                "pending", "fair_ratios"} <= set(tick)
+        assert len(tick["slots"]) == gp.max_slots
+        assert set(tick["width"]) == {"prefill", "decode", "free"}
+        assert set(tick["kv"]) == {"in_use", "free", "evictable"}
+        assert set(tick["fair_ratios"]) >= {"gold", "bronze"}
+        # some captured tick saw a tenant-labelled live slot
+        assert any(sl and sl["tenant"] in ("gold", "bronze")
+                   for t_ in d["ticks"] for sl in t_["slots"])
+    finally:
+        gp.close()
+
+
+def test_door_rejection_is_all_queue_and_never_samples_ttft(small_gpt):
+    """Satellite 2: a 429 door rejection reports queue_share=1.0 on the
+    terminal span and never enters the TTFT histogram — a zero-valued
+    sample would drag the latency percentiles toward the shed path."""
+    mon = SLOMonitor({"ttft_p95_ms": 60000.0, "availability": 0.5})
+    gp = _make(small_gpt, slo=mon,
+               admission=AdmissionController(max_queue_depth=0))
+    try:
+        with pytest.raises(ServerBusy):
+            gp.infer(np.arange(3, dtype="int64"), timeout=30)
+        spans = [s for s in gp.tracer.spans() if s.name == "request"]
+        assert spans, "door rejection must still close the request trace"
+        tags = spans[-1].tags
+        assert tags["outcome"] == "rejected" and tags["status"] == 429
+        assert tags["queue_share"] == 1.0
+        assert tags["prefill_share"] == 0.0
+        assert tags["paused_share"] == 0.0
+        assert tags["decode_share"] == 0.0
+
+        text = render_prometheus(gp.metrics.registry)
+        # family declared, but NO series: the rejected request sampled
+        # neither a bucket nor a count
+        assert "paddle_serving_ttft_seconds_bucket" not in text
+        assert "paddle_serving_ttft_seconds_count" not in text
+        assert "paddle_serving_tpot_seconds_count" not in text
+
+        # availability saw the terminal, and a 429 is GOOD (client
+        # backpressure, not an availability hit)
+        pol = mon.snapshot()["policies"]["availability"]
+        assert pol["total_events"] == 1 and pol["bad_events"] == 0
+    finally:
+        gp.close()
+
+
+def test_server_slo_and_debug_ticks_endpoints(small_gpt):
+    """/slo and /debug/ticks: JSON when wired, 404 when absent (the
+    absent-iff-off gauge contract), ?last=N bounds, malformed last -> 400;
+    the JSON /metrics snapshot carries tracer drop + ring occupancy."""
+    mon = SLOMonitor({"ttft_p95_ms": 60000.0, "availability": 0.99})
+    gp = _make(small_gpt, slo=mon, flight_recorder=8)
+    srv = InferenceServer(None, generator=gp).start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        ids = np.arange(5, dtype="int64")
+        assert _post_ids(base, "/generate", ids)[0] == 200
+
+        status, body, hdrs = _get(base, "/slo")
+        assert status == 200
+        assert hdrs["Content-Type"] == "application/json"
+        slo = json.loads(body)
+        assert set(slo["policies"]) == {"ttft_p95_ms", "availability"}
+        assert slo["alerting"] == []
+        assert slo["policies"]["availability"]["total_events"] == 1
+
+        status, body, hdrs = _get(base, "/debug/ticks")
+        assert status == 200
+        dumps = json.loads(body)
+        assert list(dumps) == [gp.flight.name]
+        d = dumps[gp.flight.name]
+        assert d["capacity"] == 8 and d["recorded"] > 0
+        assert len(d["ticks"]) <= 8
+
+        status, body, _ = _get(base, "/debug/ticks?last=1")
+        assert status == 200
+        assert len(json.loads(body)[gp.flight.name]["ticks"]) == 1
+
+        assert _get(base, "/debug/ticks?last=soon")[0] == 400
+
+        status, body, _ = _get(base, "/metrics")
+        assert status == 200
+        snap = json.loads(body)
+        assert snap["tracer"]["generator"]["dropped"] == 0
+        assert snap["tracer"]["generator"]["recorded_spans"] > 0
+        assert snap["flight_recorder"]["capacity"] == 8
+        assert snap["flight_recorder"]["occupancy"] > 0
+    finally:
+        srv.stop()
+        gp.close()
+
+
+def test_server_endpoints_404_without_slo_or_recorder():
+    srv = InferenceServer(None).start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        status, body, _ = _get(base, "/slo")
+        assert status == 404 and b"no SLO policy" in body
+        status, body, _ = _get(base, "/debug/ticks")
+        assert status == 404 and b"no flight recorder" in body
+    finally:
+        srv.stop()
+
+
+@pytest.mark.chaos
+def test_chaos_slo_breach_marks_alert_in_flight_dump(small_gpt):
+    """Acceptance: a chaos-forced SLO breach (fault-injected latency blows
+    a tight TTFT objective) fires the alert edge, the scheduler-wired
+    callback marks it in the flight recorder, and the dump's ticks contain
+    the breaching tenant's slot state."""
+    f = FaultInjector()
+    f.install("predictor.generate", delay=0.05, times=6)
+    led = TenantLedger()
+    led.register("gold", weight=2.0)
+    seen = []
+    mon = SLOMonitor({"ttft_p95_ms": 1.0, "availability": 0.99},
+                     fast_window_s=1.0, slow_window_s=30.0,
+                     burn_threshold=1.0)
+    mon.on_alert(lambda p: seen.append(p.name))
+    gp = _make(small_gpt, faults=f, qos=led, slo=mon, flight_recorder=True)
+    try:
+        rng = np.random.default_rng(7)
+        prompts = [rng.integers(0, 160, n).astype("int64") for n in (5, 6)]
+        results = {}
+        ts = [threading.Thread(
+            target=lambda i=i: results.update(
+                {i: gp.infer(prompts[i], timeout=300, tenant="gold")}))
+            for i in range(len(prompts))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=300)
+        assert len(results) == len(prompts)
+        assert f.fired("predictor.generate") > 0
+
+        # the breach fired the edge exactly once per alerting policy
+        assert "ttft_p95_ms" in seen
+
+        d = gp.flight.dump()
+        assert d["recorded"] > 0
+        alerts = [a for a in d["alerts"] if a["slo"] == "ttft_p95_ms"]
+        assert alerts, "scheduler must wire SLO alerts into the recorder"
+        assert alerts[0]["state"] == "alerting"
+        assert 0 <= alerts[0]["at_tick"] <= d["recorded"]
+        assert alerts[0]["burn_fast"] >= 1.0
+
+        # the postmortem contains the breaching tenant's slot state
+        assert any(sl is not None and sl["tenant"] == "gold"
+                   for t_ in d["ticks"] for sl in t_["slots"])
+    finally:
+        gp.close()
