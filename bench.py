@@ -1365,6 +1365,135 @@ def slo_observability_fields(out):
     return out
 
 
+def bench_serving_utilization(on_accel, dev):
+    """UtilizationLedger tax (ISSUE-19): the two-tenant serving-pressure
+    workload on the continuous scheduler with per-tick FLOPs attribution on
+    (utilization=True) vs the same scheduler bare. The instrumented leg's
+    ledger snapshot rides in the output so `serving_utilization_fields`
+    can audit the conservation law (issued == useful + pad + spec_waste,
+    sum(tenant bills) == useful) off the measured run, and the shared
+    model's runner cache is sized before/after so the flops probe is
+    PROVEN not to compile anything new. `overhead_pct` <= 5% is the
+    acceptance gate (same interleaved best-of-4 pairs methodology as
+    bench_slo_observability — short walls, alternating legs share the
+    noise regime, min drops the hiccups)."""
+    import threading as _threading
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.qos import TenantLedger
+    from paddle_tpu.inference.scheduler import (
+        ContinuousGenerateBatchingPredictor,
+    )
+    from paddle_tpu.models.gpt import GPTForCausalLM
+
+    paddle.seed(0)
+    if on_accel:
+        cfg, P, NEW, clients, slots = _gpt350m_cfg(), 64, 32, 24, 8
+        blocks, bs = 64, 32
+    else:
+        cfg, P, NEW, clients, slots = \
+            _gpt_smoke_cfg(max_position=64), 8, 32, 32, 4
+        blocks, bs = 32, 8
+    kern = "pallas" if on_accel else "xla"
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (clients, P)).astype(np.int64)
+
+    def one_leg(instrumented):
+        ledger = TenantLedger()
+        ledger.register("gold", weight=2.0, priority=1)
+        ledger.register("bronze", weight=1.0, priority=1)
+        sched = ContinuousGenerateBatchingPredictor(
+            model, max_slots=slots, prefill_chunk=P, decode_steps=4,
+            max_new_tokens=NEW, decode_kernel=kern, block_size=bs,
+            num_blocks=blocks, max_seq_len=P + NEW, qos=ledger,
+            utilization=bool(instrumented))
+        try:
+            sched.infer(ids[0], timeout=600, tenant="gold")  # compile, untimed
+
+            def client(i):
+                sched.infer(ids[i], timeout=600,
+                            tenant="gold" if i % 2 else "bronze")
+
+            t0 = time.perf_counter()
+            threads = [_threading.Thread(target=client, args=(i,))
+                       for i in range(clients)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            snap = sched.util.snapshot() if sched.util is not None else None
+        finally:
+            sched.close()
+        return wall, snap
+
+    # throwaway pass compiles the step programs so neither measured leg
+    # pays compilation; the runner-cache size afterwards is the baseline
+    # the zero-recompile audit compares against (the flops probe traces
+    # via .lower() — it must never add a compiled program)
+    one_leg(True)
+    programs_before = len(getattr(model, "_generate_cache", {}) or {})
+    plain_walls, inst_runs = [], []
+    for _ in range(4):
+        plain_walls.append(one_leg(False)[0])
+        inst_runs.append(one_leg(True))
+    plain_wall = min(plain_walls)
+    inst_wall = min(w for w, _ in inst_runs)
+    snap = inst_runs[-1][1]
+    programs_after = len(getattr(model, "_generate_cache", {}) or {})
+    out = {
+        "instrumented_wall_sec": round(inst_wall, 4),
+        "plain_wall_sec": round(plain_wall, 4),
+        "clients": clients, "prompt": P, "new_tokens": NEW, "slots": slots,
+        "utilization": snap,
+        "new_compiled_programs": programs_after - programs_before,
+    }
+    serving_utilization_fields(out)
+    return out, None
+
+
+def serving_utilization_fields(out):
+    """Gate fields for the serving_utilization section: wall with the
+    FLOPs ledger on vs off -> `overhead_pct` (clamped at 0) and `audit`:
+
+    * "serving-utilization-overhead"    — ledger costs > 5%
+    * "utilization-idle"                — the instrumented leg attributed
+      nothing (zero ticks or zero issued FLOPs: the overhead number would
+      be a measurement of nothing)
+    * "utilization-conservation"        — the ledger broke its own law:
+      issued != useful + pad + spec_waste, or sum(tenants) != useful
+    * "utilization-recompile"           — the flops probe grew the runner
+      cache (it must trace, never compile)
+    * "ok"                              — all of the above hold
+
+    Pure function of the measured dict so tests pin the taxonomy on
+    synthetic inputs."""
+    t, u = out.get("instrumented_wall_sec"), out.get("plain_wall_sec")
+    if not (t and u):
+        return out
+    out["overhead_pct"] = round(100.0 * max(0.0, (t - u) / u), 2)
+    snap = out.get("utilization") or {}
+    fl = snap.get("flops") or {}
+    issued = fl.get("issued", 0)
+    conserved = (
+        issued == (fl.get("useful", 0) + fl.get("pad_waste", 0)
+                   + fl.get("spec_waste", 0))
+        and sum((snap.get("tenants") or {}).values()) == fl.get("useful", 0))
+    if out["overhead_pct"] > 5.0:
+        out["audit"] = "serving-utilization-overhead"
+    elif not snap.get("ticks") or not issued:
+        out["audit"] = "utilization-idle"
+    elif not conserved:
+        out["audit"] = "utilization-conservation"
+    elif out.get("new_compiled_programs"):
+        out["audit"] = "utilization-recompile"
+    else:
+        out["audit"] = "ok"
+    return out
+
+
 def bench_train_observability_overhead(on_accel, dev):
     """Training-telemetry tax (ISSUE-4): the GPT smoke training step with a
     StepMonitor bound vs bare — per-step spans, throughput/MFU gauges, the
@@ -2150,6 +2279,15 @@ def main():
     except Exception:
         pass
     try:
+        util_obs, util_obs_err = bench_serving_utilization(on_accel, dev)
+    except Exception as e:
+        util_obs, util_obs_err = None, {"error": repr(e)[:200]}
+    gc.collect()
+    try:
+        jax.clear_caches()
+    except Exception:
+        pass
+    try:
         train_obs, train_obs_err = bench_train_observability_overhead(
             on_accel, dev)
     except Exception as e:
@@ -2247,6 +2385,8 @@ def main():
             "observability_overhead": obs if obs is not None else obs_err,
             "slo_observability": (slo_obs if slo_obs is not None
                                   else slo_obs_err),
+            "serving_utilization": (util_obs if util_obs is not None
+                                    else util_obs_err),
             "train_observability_overhead": (train_obs if train_obs is not None
                                              else train_obs_err),
             "checkpoint_overhead": ckpt if ckpt is not None else ckpt_err,

@@ -804,6 +804,21 @@ BUILTIN_THREAD_ALLOWLIST = Allowlist([
                "protocol — a blocking read under it IS the framing contract "
                "(two interleaved writers would corrupt the wire format)"),
     AllowlistEntry(
+        "unguarded-write", subject="thread-lint", contains="._last_launch",
+        reason="tick-thread-only stash: the launch-timing hook writes it and "
+               "the utilization tick fns read it back on the SAME scheduler "
+               "loop thread within one launch — no second thread ever "
+               "touches it, and taking _slot_lock inside the timing hook "
+               "would risk lock re-entry from launch paths"),
+    AllowlistEntry(
+        "unguarded-write", subject="thread-lint",
+        contains="InferenceServer.profile_dir",
+        reason="lazy tmpdir resolution runs only while self._profile_lock "
+               "is held: the /debug/profile handler acquires it "
+               "non-blockingly (single-flight, 409 otherwise) before "
+               "calling _capture_profile, so writers are serialized — the "
+               "lint can't see the caller-held lock"),
+    AllowlistEntry(
         "raw-clock", subject="thread-lint",
         contains="CheckpointManager._commit reads time.time()",
         reason="the manifest's wall_time stamp is informational only; "

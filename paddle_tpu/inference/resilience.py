@@ -284,6 +284,15 @@ class ServingMetrics:
             "paddle_serving_request_latency_seconds",
             "Accepted-request latency to terminal outcome",
             labels=("component",), buckets=DEFAULT_LATENCY_BUCKETS)
+        self._utilization = None
+
+    def attach_utilization(self, ledger):
+        """ISSUE-19: ride the utilization ledger's compact block on every
+        snapshot() — operators get mfu / flops-by-kind / host-gap tail from
+        the JSON /metrics page without a Prometheus scrape (mirrors the
+        PR 18 tracer/flight blocks)."""
+        with self._lock:
+            self._utilization = ledger
 
     def inc(self, name, n=1):
         with self._lock:
@@ -322,4 +331,6 @@ class ServingMetrics:
             v = self._pct(lat, q)
             if v is not None:
                 out[name] = round(v * 1000.0, 3)
+        if self._utilization is not None:
+            out["utilization"] = self._utilization.metrics_block()
         return out

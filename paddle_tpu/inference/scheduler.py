@@ -315,6 +315,25 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
                          conftest fixture on test failure. Overhead is
                          bench-gated <= 5% (slo_observability leg). Default
                          False: no capture, tick loop byte-identical.
+    utilization          ISSUE-19: per-tick FLOPs attribution. True builds
+                         a default `observability.utilization.
+                         UtilizationLedger`; pass an instance to configure
+                         (injected clock / peak_flops). Every tick's
+                         issued step-program FLOPs (cost_flops on the
+                         lowered runner, one trace per program key) split
+                         into useful / pad / spec_waste with EXACT integer
+                         conservation, useful FLOPs bill per tenant
+                         (paused time never bills — preempted sequences
+                         are off-slot), and tick wall splits into launch
+                         vs host gap. Exports `paddle_serving_flops_total{
+                         kind}`, `paddle_tenant_flops_total{tenant}`,
+                         `paddle_serving_host_gap_seconds` and (with a
+                         known device peak) `paddle_serving_mfu`; JSON at
+                         `/utilization`, per-tick fields on the flight
+                         ring. Overhead is bench-gated <= 5%
+                         (serving_utilization leg) with zero new compiled
+                         programs. Default False: no attribution, launches
+                         carry no flops probe.
     """
 
     _component = "continuous"
@@ -339,7 +358,8 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
                  eos_token_id=None, max_defers=32, spec_k=0, drafter="ngram",
                  admit_policy="fifo", prefix_cache=False, warmup=False,
                  compile_cache_dir=None, hbm_budget=None, adapters=None,
-                 qos=None, slo=None, flight_recorder=False, **kwargs):
+                 qos=None, slo=None, flight_recorder=False,
+                 utilization=False, **kwargs):
         self.max_slots = int(max_slots)
         self.prefill_chunk = int(prefill_chunk)
         self.prefill_token_budget = int(prefill_token_budget
@@ -416,6 +436,25 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
             self.flight = FlightRecorder(capacity=flight_recorder)
         else:
             self.flight = flight_recorder
+        # ISSUE-19 utilization ledger: published before the tick thread
+        # starts (tick fns and _flight_tick read it). The timing hook grows
+        # a wants_flops marker ONLY when a ledger is installed — that is
+        # what gates the one-trace-per-program flops probe in generation.py,
+        # so a bare scheduler's launch path is byte-identical.
+        if utilization is False or utilization is None:
+            self.util = None
+        elif utilization is True:
+            from ..observability.utilization import UtilizationLedger
+            self.util = UtilizationLedger()
+        else:
+            self.util = utilization
+        self._last_launch = None        # tick-thread-only hook stash
+        hook = self._gen_timing
+        if self.util is not None:
+            def hook(info, _h=self._gen_timing):
+                _h(info)
+            hook.wants_flops = True
+        self._timing_hook = hook
         self._ttft_hist = None
         self._tpot_hist = None
         # gauges scrape from other threads; witness-wrapped under chaos
@@ -650,6 +689,12 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
                         p.name, state=p.state(),
                         burn_fast=round(p.burn_rate("fast"), 4),
                         burn_slow=round(p.burn_rate("slow"), 4)))
+        # ISSUE-19 utilization series exist IFF a ledger is installed (same
+        # absent-iff-off exposition contract); the MFU gauge additionally
+        # needs a known device peak — the ledger itself enforces that.
+        if self.util is not None:
+            self.util.bind_metrics(reg, component=self._component)
+            self.metrics.attach_utilization(self.util)
         if self.flight is not None:
             occ = reg.gauge(
                 "paddle_flightrec_ticks",
@@ -679,6 +724,7 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
         the AOT warmup armed it, any launch that had to cold-build its step
         program is a compile-surface violation — counted per program and
         reported to the chaos-suite witness (inference/warmup.py)."""
+        self._last_launch = info    # ISSUE-19: tick fns read flops/launch_s
         self._decode_hist.labels(self._component, info["path"]).observe(
             info["launch_s"])
         if info["compiled"] and self._warm_armed.is_set():
@@ -942,11 +988,14 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
                     if self._phase_count(None) == 0:
                         continue        # _admit parked briefly on the queue
                     self._busy = True
+                    if self.util is not None:   # ISSUE-19 tick window opens
+                        self.util.tick_begin()
                     try:
                         self._retire_unserviceable()
                         self._prefill_tick()
                         self._decode_tick()
-                        self._flight_tick()     # ISSUE-18 postmortem ring
+                        self._util_tick()       # ISSUE-19 close BEFORE the
+                        self._flight_tick()     # ring captures last_tick
                     finally:
                         self._busy = False
                 except ThreadDeath:
@@ -1412,6 +1461,37 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
                 tenant=getattr(req, "tenant", None))
         return won
 
+    def _util_tick(self):
+        """Close the utilization ledger's tick window (ISSUE-19): tick wall
+        minus the recorded launch walls becomes the host gap, the per-kind
+        flops land on the counters. Ledger failures never take the tick
+        loop down (same contract as the flight ring)."""
+        if self.util is None:
+            return
+        try:
+            self.util.tick_end()
+        except ThreadDeath:
+            raise
+        except Exception:       # pragma: no cover - telemetry must not bite
+            pass
+
+    def _util_launch(self, program, total_units, slot_units, spec_units=0):
+        """Attribute the tick's just-returned launch to the ledger. The
+        timing hook stashed the launch's flops/launch_s on this thread; a
+        path mismatch means the hook never fired for this program (warmup
+        interleave) — skip rather than misattribute."""
+        info = self._last_launch
+        if info is None or info.get("path") != program:
+            return
+        try:
+            self.util.record_launch(program, info.get("flops"),
+                                    info.get("launch_s", 0.0),
+                                    total_units, slot_units, spec_units)
+        except ThreadDeath:
+            raise
+        except Exception:       # pragma: no cover - telemetry must not bite
+            pass
+
     def _flight_tick(self):
         """One flight-recorder capture at the tick boundary (ISSUE-18): the
         slot map with per-slot tenant/adapter/phase/progress, batch widths,
@@ -1446,6 +1526,11 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
             }
             if self.qos is not None:
                 snap["fair_ratios"] = self.qos.fair_snapshot()
+            if self.util is not None and self.util.last_tick is not None:
+                # ISSUE-19: the tick's own flops/gap decomposition rides
+                # the ring — /debug/ticks shows WHY MFU dipped (which
+                # slots were empty, which drafts died)
+                snap["util"] = self.util.last_tick
             rec.record(snap)
         except ThreadDeath:
             raise
@@ -1644,7 +1729,7 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
                 temperature=temps, top_k=tks,
                 eos_token_id=self.eos_token_id,
                 decode_kernel=self.decode_kernel, seed=next(self._seed),
-                timing_hook=self._gen_timing, **akw)
+                timing_hook=self._timing_hook, **akw)
         except ThreadDeath:
             raise
         except Exception as e:
@@ -1653,6 +1738,11 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
             return
         self.breaker.record_success()
         self.metrics.inc("prefill_ticks")
+        if self.util is not None:
+            # ISSUE-19: useful positions are exactly each pick's take; the
+            # S*C - sum(take) remainder (idle slots, chunk tail) is pad
+            self._util_launch("prefill_chunk", S * C,
+                              [(s.tenant, take) for _, s, take in picks])
         tk = np.asarray(tk._value if hasattr(tk, "_value") else tk)
         self._span_each(reqs, "prefill_chunk", t0, self.tracer.now_us(),
                         slots=len(picks),
@@ -1725,7 +1815,7 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
                 max_lens=maxlens, temperature=temps, top_k=tks,
                 eos_token_id=self.eos_token_id,
                 decode_kernel=self.decode_kernel, seed=next(self._seed),
-                timing_hook=self._gen_timing, **akw)
+                timing_hook=self._timing_hook, **akw)
         except ThreadDeath:
             raise
         except Exception as e:
@@ -1736,10 +1826,17 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
         toks = np.asarray(toks._value if hasattr(toks, "_value") else toks)
         self._span_each(reqs, "decode_step", t0, self.tracer.now_us(),
                         slots=len(dec), steps=T)
+        units = []
         for i, s in dec:
             s.length += T
             s.tok = int(toks[i, -1])
+            n0 = s.n_tok
             self._absorb(i, s, toks[i])
+            # ISSUE-19: useful = tokens the sequence actually ABSORBED this
+            # tick (EOS-frozen / over-cap rows are pad, like idle slots)
+            units.append((s.tenant, s.n_tok - n0))
+        if self.util is not None:
+            self._util_launch("decode_step", S * T, units)
 
     def _verify_tick(self):
         """Speculative decode tick (spec_k > 0): draft on the host, verify
@@ -1802,7 +1899,7 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
                 chunk, offs, dlens, active, self.kv_cache, tables,
                 max_lens=maxlens, temperature=temps, top_k=tks,
                 decode_kernel=self.decode_kernel, seed=next(self._seed),
-                timing_hook=self._gen_timing, **akw)
+                timing_hook=self._timing_hook, **akw)
         except ThreadDeath:
             raise
         except Exception as e:
@@ -1823,12 +1920,21 @@ class ContinuousGenerateBatchingPredictor(GenerateBatchingPredictor):
         with self._slot_lock:
             self._spec_drafted += drafted
             self._spec_accepted += accepted
+        units = []
         for i, s in dec:
             a = int(acc[i])
             s.length += 1 + a   # committed rows: accepted prefix + emitted
             s.tok = int(nxt[i])
+            n0 = s.n_tok
             self._absorb(i, s, [int(t) for t in chunk[i, 1:1 + a]]
                          + [s.tok])
+            # ISSUE-19: useful = absorbed (accepted prefix + the emitted
+            # token, minus any over-cap shortfall); rejected drafts are
+            # spec_waste; the rest of the S*(K+1) window is pad
+            units.append((s.tenant, s.n_tok - n0))
+        if self.util is not None:
+            self._util_launch("verify_step", S * (K + 1), units,
+                              spec_units=drafted - accepted)
 
     # ------------------------------------------------------------- lifecycle
     def _abandon_slots(self):

@@ -57,6 +57,13 @@ __all__ = ["BatchingPredictor", "GenerateBatchingPredictor",
 # server's picture of its own load is stale long before that.
 RETRY_AFTER_CAP = 60.0
 
+# /debug/profile duration ceiling (ms). A device trace grows with capture
+# length and the handler thread sleeps through the whole window — 10s is
+# plenty to catch a steady-state tick pattern and short enough that a fat-
+# fingered ms=3600000 can't pin a handler (and a trace directory) for an
+# hour. Larger requests are a client bug: 400, not a silent clamp.
+PROFILE_MS_CAP = 10_000
+
 
 def retry_after_header(retry_after, cap=RETRY_AFTER_CAP) -> str:
     """Retry-After header value from a shed's computed hint: ceil to whole
@@ -799,7 +806,11 @@ class InferenceServer:
     GET /health (liveness), GET /readyz (readiness: 503 while draining),
     GET /metrics (legacy JSON counters; `?format=prom` or an Accept header
     naming text/plain serves the Prometheus text exposition of the full
-    observability registry). Overload answers 429/503 with Retry-After;
+    observability registry), GET /utilization (UtilizationLedger JSON:
+    flops by kind, tenant chargeback, serving MFU; 404 without a ledger),
+    GET /debug/profile?ms=N (on-demand jax.profiler capture, single-flight:
+    409 while one is running, 400 on malformed/oversized N).
+    Overload answers 429/503 with Retry-After;
     deadline expiry answers 504; stop() drains in-flight work before tearing
     the batchers down. EVERY response (success and every error path) carries
     `X-Trace-Id` — minted here, or propagated from the client's own
@@ -808,10 +819,18 @@ class InferenceServer:
 
     def __init__(self, predictor, host="127.0.0.1", port=0, batching=True,
                  max_batch_size=8, max_delay_ms=2.0, generator=None,
-                 default_timeout=30.0, faults=None, tracer=None):
+                 default_timeout=30.0, faults=None, tracer=None,
+                 profile_dir=None):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         self.predictor = predictor
+        # ISSUE-19 on-demand device profiling: GET /debug/profile?ms=N
+        # captures a duration-capped jax.profiler trace under profile_dir
+        # (a fresh temp dir per server when unset). Single-flight by
+        # construction: one non-blocking lock, concurrent captures 409.
+        self.profile_dir = profile_dir
+        self._profile_lock = threading.Lock()
+        self._profile_seq = itertools.count(1)
         self.batcher = (BatchingPredictor(predictor, max_batch_size,
                                           max_delay_ms, faults=faults,
                                           tracer=tracer)
@@ -851,7 +870,8 @@ class InferenceServer:
                 p = self.path.split("?", 1)[0]
                 return p if p in ("/health", "/readyz", "/metrics",
                                   "/predict", "/generate", "/slo",
-                                  "/debug/ticks") else "other"
+                                  "/debug/ticks", "/utilization",
+                                  "/debug/profile") else "other"
 
             def _reply(self, status, body, headers=()):
                 # count BEFORE writing: a client that saw the response must
@@ -1049,6 +1069,11 @@ class InferenceServer:
                             "capacity": fl.capacity,
                             "dropped": fl.dropped,
                         }
+                    # ISSUE-19: compact utilization block (mfu, flops by
+                    # kind, host-gap tail) next to the tracer/flight blocks
+                    util = getattr(outer.generator, "util", None)
+                    if util is not None:
+                        snap["utilization"] = util.metrics_block()
                     self._reply(200, json.dumps(snap).encode(),
                                 [("Content-Type", "application/json")])
                 elif path == "/slo":
@@ -1082,6 +1107,21 @@ class InferenceServer:
                     else:
                         self._reply(200, json.dumps(dumps).encode(),
                                     [("Content-Type", "application/json")])
+                elif path == "/utilization":
+                    # ISSUE-19: full UtilizationLedger snapshot — flops by
+                    # kind, tenant chargeback, MFU, host-gap tail, last
+                    # tick. 404 when no ledger installed (absent-iff-off,
+                    # same contract as /slo and /debug/ticks).
+                    import json
+
+                    snaps = self._find_utilization()
+                    if not snaps:
+                        self._reply(404, b"no utilization ledger installed")
+                    else:
+                        self._reply(200, json.dumps(snaps).encode(),
+                                    [("Content-Type", "application/json")])
+                elif path == "/debug/profile":
+                    self._do_profile(query)
                 else:
                     self._reply(404, b"")
 
@@ -1109,6 +1149,65 @@ class InferenceServer:
                         if f is not None:
                             dumps[f.name] = f.dump(last=last)
                 return dumps
+
+            def _find_utilization(self):
+                """Utilization snapshots keyed by component — one entry for
+                a plain scheduler, one per replica for a fleet (same shape
+                as _find_flight_dumps)."""
+                u = getattr(outer.generator, "util", None)
+                if u is not None:
+                    name = getattr(outer.generator, "_component", "generator")
+                    return {name: u.snapshot()}
+                snaps = {}
+                if hasattr(outer.generator, "_snapshot"):
+                    for rep in outer.generator._snapshot():
+                        u = getattr(rep.predictor, "util", None)
+                        if u is not None:
+                            snaps[rep.name] = u.snapshot()
+                return snaps
+
+            def _do_profile(self, query):
+                """ISSUE-19: GET /debug/profile?ms=N — capture N ms of
+                jax.profiler device trace, join it with the serving tracer
+                (shared perf_counter timebase), answer JSON naming the
+                artifacts. Taxonomy: malformed/absent/oversized ms= is a
+                client bug (400); a concurrent capture answers 409 (the
+                profiler is a process-global singleton — two start_trace
+                calls corrupt each other); a profiler failure answers 503
+                (retryable: the runtime may just be busy)."""
+                import json
+
+                ms = None
+                for part in query.split("&"):
+                    if part.startswith("ms="):
+                        try:
+                            ms = int(part[3:])
+                        except ValueError:
+                            self._reply(400, b"malformed ms= (need int)")
+                            return
+                if ms is None:
+                    self._reply(400, b"missing ms= duration")
+                    return
+                if ms <= 0 or ms > PROFILE_MS_CAP:
+                    self._reply(
+                        400,
+                        f"ms= out of range: {ms} (need 1..{PROFILE_MS_CAP})"
+                        .encode())
+                    return
+                if not outer._profile_lock.acquire(blocking=False):
+                    self._reply(409, b"profile capture already in flight",
+                                [("Retry-After", "1")])
+                    return
+                try:
+                    out = outer._capture_profile(ms)
+                except Exception as e:
+                    self._reply(503, repr(e).encode(),
+                                [("Retry-After", "1")])
+                    return
+                finally:
+                    outer._profile_lock.release()
+                self._reply(200, json.dumps(out).encode(),
+                            [("Content-Type", "application/json")])
 
             def _wants_stream(self):
                 """SSE opt-in: `X-Stream: sse`, or Accept: text/event-stream
@@ -1251,6 +1350,48 @@ class InferenceServer:
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True, name="inference-server")
+
+    def _capture_profile(self, ms):
+        """One duration-capped jax.profiler capture (ISSUE-19).
+
+        Runs under self._profile_lock (the handler holds it): starts the
+        device trace into a fresh numbered directory under profile_dir,
+        sleeps out the window on the handler thread, stops the trace, then
+        writes a joined chrome view (host tracer spans + any profiler
+        events share the perf_counter-µs timebase) next to the raw trace.
+        The join is best-effort — a tracer-less server still returns the
+        raw trace directory."""
+        import os
+        import tempfile
+
+        import jax
+
+        base = self.profile_dir
+        if base is None:
+            base = self.profile_dir = tempfile.mkdtemp(
+                prefix="paddle_profile_")
+        run_dir = os.path.join(base, f"capture_{next(self._profile_seq):04d}")
+        os.makedirs(run_dir, exist_ok=True)
+        jax.profiler.start_trace(run_dir)
+        try:
+            time.sleep(ms / 1000.0)
+        finally:
+            jax.profiler.stop_trace()
+        joined = None
+        tracer = None
+        for w in (self.generator, self.batcher):
+            tracer = getattr(w, "tracer", None)
+            if tracer is not None:
+                break
+        if tracer is not None:
+            from ..observability.trace import export_joined_chrome
+
+            joined = os.path.join(run_dir, "joined_host_trace.json")
+            try:
+                export_joined_chrome(joined, tracer=tracer)
+            except Exception:
+                joined = None   # raw device trace still stands on its own
+        return {"ms": int(ms), "trace_dir": run_dir, "joined_chrome": joined}
 
     def render_prometheus(self) -> str:
         """One merged Prometheus text exposition over the server, batcher and

@@ -200,6 +200,18 @@ class SpecStats:
                 "emitted": self.emitted,
                 "acceptance_rate": round(self.acceptance_rate, 4)}
 
+    def unit_split(self, width) -> tuple[int, int, int]:
+        """(useful, spec_waste, pad) verify-row units for the utilization
+        ledger (ISSUE-19), out of ``launches * width`` total rows: every
+        emitted token was a useful row, every rejected draft was a
+        spec-waste row, the rest of each fixed-width launch was padding.
+        Same convention as the scheduler's per-tick attribution, so a
+        single-stream speculative run decomposes its FLOPs identically."""
+        total = self.launches * int(width)
+        useful = min(self.emitted, total)
+        spec = min(self.wasted, total - useful)
+        return useful, spec, total - useful - spec
+
     def __repr__(self):
         return f"SpecStats({self.to_dict()})"
 

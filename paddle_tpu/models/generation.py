@@ -192,7 +192,8 @@ class GenerationMixin:
             return run, True
 
     @staticmethod
-    def _emit_timing(timing_hook, path, B, P, new_tokens, compiled, t0):
+    def _emit_timing(timing_hook, path, B, P, new_tokens, compiled, t0,
+                     flops=None):
         """Decode timing hook (observability layer): called once per launch
         with host-wall phase numbers. The decode loop itself is ONE compiled
         scan — there is no host boundary per token to hook — so the per-step
@@ -200,14 +201,46 @@ class GenerationMixin:
         serving metrics and the `observability_overhead` bench track. The
         same interval is also recorded as a profiler RecordEvent (when a
         Profiler is recording), so serving spans, this hook and profiler
-        step markers all land on one timebase."""
+        step markers all land on one timebase. ``flops`` (ISSUE-19) is the
+        program's issued FLOPs per launch — present only when the hook
+        asked for it (``wants_flops``), None otherwise."""
         if timing_hook is None:
             return
         dt = time.perf_counter() - t0
         timing_hook({"path": path, "batch": int(B), "prompt_len": int(P),
                      "new_tokens": int(new_tokens), "compiled": bool(compiled),
-                     "launch_s": dt,
+                     "launch_s": dt, "flops": flops,
                      "per_token_s": dt / max(1, int(new_tokens))})
+
+    def _flops_of(self, cache_key, run, args):
+        """Issued FLOPs of one execution of the step program behind
+        ``cache_key`` (ISSUE-19 utilization ledger).
+
+        jax.jit runners carry no cost analysis, but their LOWERED module
+        does — ``run.lower(*args).cost_analysis()`` needs a trace, not an
+        XLA compile, and agrees with the compiled executable's own number.
+        The result is constant per cache key (fixed-width programs), so one
+        trace per program lifetime, cached next to the runner cache; the
+        post-ready compile sentinel is untouched because nothing here goes
+        through _runner_for. Benign double-compute race under concurrency
+        (same value lands twice). 0.0 when the backend reports nothing."""
+        cache = getattr(self, "_flops_cache", None)
+        if cache is None:
+            cache = self._flops_cache = {}
+        val = cache.get(cache_key)
+        if val is None:
+            from ..observability.xla import cost_flops
+
+            try:
+                val = cost_flops(run.lower(*args))
+            except Exception:   # introspection must never break a launch
+                val = 0.0
+            cache[cache_key] = val
+        return val
+
+    @staticmethod
+    def _wants_flops(timing_hook) -> bool:
+        return bool(getattr(timing_hook, "wants_flops", False))
 
     @staticmethod
     def _check_deadline(deadline, where):
@@ -598,18 +631,22 @@ class GenerationMixin:
         was_training = self.training
         self.eval()
         try:
-            t0 = time.perf_counter()
-            with RecordEvent("generate.prefill_chunk"):
-                tok, new_k, new_v = run(
-                    state, ids, jnp.asarray(offsets, jnp.int32),
+            args = (state, ids, jnp.asarray(offsets, jnp.int32),
                     jnp.asarray(chunk_lens, jnp.int32),
                     jnp.asarray(block_tables, jnp.int32), temps, tks,
                     tuple(kv_cache.k_pages), tuple(kv_cache.v_pages),
                     *self._adapter_extra(adapters, adapter_slots, S),
                     jax.random.key(seed))
+            # ISSUE-19: probe BEFORE the launch (donation deletes the pool
+            # args after) and before t0 (the trace must not pollute launch_s)
+            flops = (self._flops_of(cache_key, run, args)
+                     if self._wants_flops(timing_hook) else None)
+            t0 = time.perf_counter()
+            with RecordEvent("generate.prefill_chunk"):
+                tok, new_k, new_v = run(*args)
                 kv_cache.commit(new_k, new_v)
             self._emit_timing(timing_hook, "prefill_chunk", S, C, 0,
-                              compiled_now, t0)
+                              compiled_now, t0, flops=flops)
             return Tensor(tok)
         finally:
             if was_training:
@@ -710,19 +747,21 @@ class GenerationMixin:
         was_training = self.training
         self.eval()
         try:
-            t0 = time.perf_counter()
-            with RecordEvent("generate.decode_step"):
-                toks, new_k, new_v = run(
-                    state, tokens, jnp.asarray(lengths, jnp.int32),
+            args = (state, tokens, jnp.asarray(lengths, jnp.int32),
                     jnp.asarray(active, bool),
                     jnp.asarray(max_lens, jnp.int32),
                     jnp.asarray(block_tables, jnp.int32), temps, tks,
                     tuple(kv_cache.k_pages), tuple(kv_cache.v_pages),
                     *self._adapter_extra(adapters, adapter_slots, S),
                     jax.random.key(seed))
+            flops = (self._flops_of(cache_key, run, args)
+                     if self._wants_flops(timing_hook) else None)
+            t0 = time.perf_counter()
+            with RecordEvent("generate.decode_step"):
+                toks, new_k, new_v = run(*args)
                 kv_cache.commit(new_k, new_v)
             self._emit_timing(timing_hook, "decode_step", S, 1, T,
-                              compiled_now, t0)
+                              compiled_now, t0, flops=flops)
             return Tensor(toks)
         finally:
             if was_training:
@@ -886,10 +925,7 @@ class GenerationMixin:
         was_training = self.training
         self.eval()
         try:
-            t0 = time.perf_counter()
-            with RecordEvent("generate.verify_step"):
-                accepted, nxt, new_k, new_v = run(
-                    state, ids, jnp.asarray(offsets, jnp.int32),
+            args = (state, ids, jnp.asarray(offsets, jnp.int32),
                     jnp.asarray(draft_lens, jnp.int32),
                     jnp.asarray(active, bool),
                     jnp.asarray(max_lens, jnp.int32),
@@ -897,9 +933,14 @@ class GenerationMixin:
                     tuple(kv_cache.k_pages), tuple(kv_cache.v_pages),
                     *self._adapter_extra(adapters, adapter_slots, S),
                     jax.random.key(seed))
+            flops = (self._flops_of(cache_key, run, args)
+                     if self._wants_flops(timing_hook) else None)
+            t0 = time.perf_counter()
+            with RecordEvent("generate.verify_step"):
+                accepted, nxt, new_k, new_v = run(*args)
                 kv_cache.commit(new_k, new_v)
             self._emit_timing(timing_hook, "verify_step", S, W, 1,
-                              compiled_now, t0)
+                              compiled_now, t0, flops=flops)
             return Tensor(accepted), Tensor(nxt)
         finally:
             if was_training:

@@ -24,6 +24,12 @@ objectives (TTFT/TPOT percentiles, availability) over injectable-clock
 rolling windows with SRE-workbook multi-window burn-rate alerting, and
 ``flightrecorder`` keeps a bounded ring of per-tick scheduler snapshots
 dumped on demand (``/debug/ticks``), on alert, or on chaos-test failure.
+``utilization`` closes the loop on the serving side of MFU: a
+``UtilizationLedger`` decomposes every tick's issued step-program FLOPs
+into useful / pad / spec-waste with exact integer conservation, bills
+useful FLOPs per tenant, splits tick wall into launch vs host gap, and
+exports ``paddle_serving_mfu`` from the same ``xla`` peak table training
+uses (``/utilization`` serves the JSON view).
 
 Span taxonomy, metric names and the scrape/join recipes live in
 docs/OBSERVABILITY.md.
@@ -55,6 +61,10 @@ from .training import (  # noqa: F401
     AnomalyEvent,
     NumericsAnomalyDetector,
     StepMonitor,
+)
+from .utilization import (  # noqa: F401
+    UtilizationLedger,
+    attribute_launch,
 )
 from .xla import (  # noqa: F401
     cost_flops,

@@ -565,6 +565,12 @@ def test_metrics_exposition_lint_and_conservation(small_gpt):
         # noise); the tracer-drop counter, by contrast, is always-on
         assert not any(n.startswith("paddle_slo_") for n in types2)
         assert "paddle_flightrec_ticks" not in types2
+        # ISSUE-19: the utilization ledger's series ride the same contract —
+        # no ledger wired here, so none of them may render
+        assert "paddle_serving_flops_total" not in types2
+        assert "paddle_tenant_flops_total" not in types2
+        assert "paddle_serving_host_gap_seconds" not in types2
+        assert "paddle_serving_mfu" not in types2
         assert "paddle_trace_dropped_spans_total" in types2
         for (name, labels), v in series2.items():
             if name == "paddle_trace_dropped_spans_total":
