@@ -1860,6 +1860,70 @@ def hbm_planning_fields(out):
     return out
 
 
+def bench_comms_lint(on_accel, dev):
+    """Sharding/collective leg (ISSUE-20): compile the three continuous
+    step programs under the tp=2 serving mesh, inventory every collective
+    GSPMD inserted into the optimized HLO (analysis/comms.py), check the
+    compiled shardings against SpecLayout.step_contract(), and run the
+    five comms rules. The gate is `high_total == 0`: a high finding means
+    a mid-program reshard appeared behind the layout contract's back, the
+    contract rotted, or the decode tick no longer fits on the wire.
+    Allowlisted findings are counted separately — suppression is visible,
+    never silent. `comms_share_of_tick` is None off accelerator (unknown
+    ICI un-gates the budget rule rather than inventing a number)."""
+    import time as _time
+
+    from paddle_tpu.analysis.comms import (analyze_step_comms,
+                                           render_comms_table,
+                                           smoke_comms_budget,
+                                           step_comms_surfaces)
+
+    t0 = _time.perf_counter()
+    surfaces = step_comms_surfaces()
+    report = analyze_step_comms(_surfaces=surfaces)
+    budget = smoke_comms_budget(surfaces)
+    decode = next((s for s in surfaces if s.get("path") == "decode_step"),
+                  None)
+    out = {
+        "surfaces": {s["name"]: {"bytes_per_launch": s["bytes_per_launch"],
+                                 "collectives": len(s["ops"]),
+                                 "loop_steps": s["loop_steps"]}
+                     for s in surfaces},
+        "bytes_per_decode_launch": (decode["bytes_per_launch"]
+                                    if decode else 0),
+        "bytes_per_tick": budget.bytes_per_tick,
+        "comms_share_of_tick": budget.share_of_tick(),
+        "tp": surfaces[0].get("tp", 1) if surfaces else 1,
+        "findings": [f.to_dict() for f in report.findings],
+        "suppressed": [{"rule": f.rule, "reason": e.reason}
+                       for f, e in report.suppressed],
+        "suppressed_total": len(report.suppressed),
+        "table": render_comms_table(surfaces),
+        "lint_wall_sec": round(_time.perf_counter() - t0, 3),
+    }
+    comms_lint_fields(out)
+    return out, None
+
+
+def comms_lint_fields(out):
+    """Aggregate + audit fields for the comms_lint section: findings-by-
+    rule, `high_total` and `audit` = ok iff zero un-allowlisted high
+    findings. Pure function of the measured dict so tests can pin the
+    wiring on synthetic inputs (same contract as graph_lint_fields).
+    `comms_share_of_tick` may be None (unknown interconnect) — preserved,
+    not coerced."""
+    by_rule: dict = {}
+    high = 0
+    for f in out.get("findings", ()):
+        by_rule[f["rule"]] = by_rule.get(f["rule"], 0) + 1
+        if f.get("severity") == "high":
+            high += 1
+    out["findings_by_rule"] = by_rule
+    out["high_total"] = high
+    out["audit"] = "ok" if high == 0 else "lint-high"
+    return out
+
+
 def _cold_start_child_impl(cache_dir):
     """Child body for the cold_start leg (ISSUE-13): ONE fresh process that
     builds a continuous predictor with `warmup=True` against a persistent
@@ -2328,6 +2392,15 @@ def main():
         hbm_plan, hbm_plan_err = bench_hbm_planning(on_accel, dev)
     except Exception as e:
         hbm_plan, hbm_plan_err = None, {"error": repr(e)[:200]}
+    gc.collect()
+    try:
+        jax.clear_caches()
+    except Exception:
+        pass
+    try:
+        comms, comms_err = bench_comms_lint(on_accel, dev)
+    except Exception as e:
+        comms, comms_err = None, {"error": repr(e)[:200]}
     try:
         cold_start, cold_start_err = bench_cold_start(on_accel, dev)
     except Exception as e:
@@ -2393,6 +2466,7 @@ def main():
             "graph_lint": lint if lint is not None else lint_err,
             "thread_lint": tlint if tlint is not None else tlint_err,
             "hbm_planning": hbm_plan if hbm_plan is not None else hbm_plan_err,
+            "comms_lint": comms if comms is not None else comms_err,
             "cold_start": (cold_start if cold_start is not None
                            else cold_start_err),
             "decode_attention": (decode_attn if decode_attn is not None

@@ -42,7 +42,17 @@ tier + temps against a declared HBM budget (rules: hbm-over-budget,
 estimate-drift, oversized-temp, pool-misfit). The runtime twin is
 ``plan_kv_pool`` — the continuous scheduler's ``hbm_budget=`` knob sizes
 its pool from the plan and publishes ``paddle_hbm_planned_bytes``.
-``--self-check`` gates all four.
+
+The fifth leg is the SHARDING & COLLECTIVE lint (``analysis/comms.py``,
+ISSUE-20): compile the continuous step programs under the tp serving
+mesh, inventory every collective GSPMD inserted into the optimized HLO
+(kind, shape, replica groups, bytes-on-wire), and check the compiled
+parameter/output shardings against ``SpecLayout.step_contract()`` (rules:
+implicit-reshard, layout-contract-drift, replicated-large-buffer,
+dead-mesh-axis, comms-over-budget — the last sized against the chip's
+ICI from ``observability.xla.ICI_BANDWIDTH_BYTES``). The runtime twin is
+``DeploymentPlan.comms`` — the deploy review reads wire-bytes-per-tick
+next to residency in one table. ``--self-check`` gates all five.
 """
 from .core import (  # noqa: F401
     Program,
@@ -76,6 +86,23 @@ from .hbm import (  # noqa: F401
     hbm_fixture_reports,
     params_bytes_of,
     plan_kv_pool,
+)
+from .comms import (  # noqa: F401
+    BUILTIN_COMMS_ALLOWLIST,
+    COMMS_RULES,
+    CollectiveOp,
+    CommsBudget,
+    CommsEstimate,
+    analyze_comms_surfaces,
+    analyze_step_comms,
+    bytes_on_wire,
+    collective_inventory,
+    comms_fixture_reports,
+    compiled_comms_surface,
+    render_comms_table,
+    sampled_logits_gather_surface,
+    smoke_comms_budget,
+    step_comms_surfaces,
 )
 from .lockwitness import (  # noqa: F401
     LockWitness,

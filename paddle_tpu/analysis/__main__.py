@@ -29,6 +29,16 @@ stale-allowlist audit (builtin suppressions that matched nothing);
 plan's residency table plus the four rules (optionally for one shipped
 serving config by NAME), or strict fixture mode over a DeploymentPlan
 ``.json`` / ``make_program()`` ``.py`` / directory PATH.
+
+ISSUE-20 adds the sharding-and-collective contract (analysis/comms.py):
+the full self-check runs it via the ``comms_surface`` zoo entry (and its
+builtin allowlist joins the stale audit); ``--comms [NAME|PATH]`` runs
+ONLY that pass — the per-program collective table (every all-gather /
+all-reduce / reduce-scatter / all-to-all / collective-permute GSPMD
+compiled into the step programs, with bytes-on-wire) plus the five comms
+rules, optionally for one step path by NAME (``prefill_chunk`` /
+``decode_step`` / ``verify_step``), or strict fixture mode over a
+``make_program()`` ``.py`` / comms-surface ``.json`` / directory PATH.
 """
 from __future__ import annotations
 
@@ -125,6 +135,16 @@ def main(argv=None) -> int:
                              "NAME is given), or strict fixture mode over a "
                              "DeploymentPlan .json / make_program() .py / "
                              "directory PATH")
+    parser.add_argument("--comms", nargs="?", const="", default=None,
+                        metavar="NAME|PATH",
+                        help="run ONLY the sharding/collective lint "
+                             "(ISSUE-20): compile the continuous step "
+                             "programs under the serving mesh, print the "
+                             "collective inventory + the five comms rules "
+                             "(for one step path when NAME is given: "
+                             "prefill_chunk, decode_step, verify_step), or "
+                             "strict fixture mode over a make_program() .py "
+                             "/ comms-surface .json / directory PATH")
     parser.add_argument("--manifest", nargs="?", const="", default=None,
                         metavar="CONFIG",
                         help="print the derived step-program inventory as "
@@ -141,6 +161,7 @@ def main(argv=None) -> int:
     from .threads import THREAD_RULES
 
     if args.list_rules:
+        from .comms import COMMS_RULES
         from .compilesurface import SURFACE_RULES
         from .hbm import HBM_RULES
 
@@ -153,6 +174,8 @@ def main(argv=None) -> int:
             print(f"{rule_id:18s} [surface] {doc.split(chr(10))[0]}")
         for rule_id, doc in HBM_RULES.items():
             print(f"{rule_id:18s} [hbm] {doc.split(chr(10))[0]}")
+        for rule_id, doc in COMMS_RULES.items():
+            print(f"{rule_id:18s} [comms] {doc.split(chr(10))[0]}")
         return 0
 
     if args.manifest is not None:
@@ -160,7 +183,29 @@ def main(argv=None) -> int:
 
     reports = []
     tables = []
-    if args.hbm is not None:
+    if args.comms is not None:
+        import os
+
+        from .comms import (_STEP_PATHS, analyze_step_comms,
+                            comms_fixture_reports, render_comms_table,
+                            step_comms_surfaces)
+
+        if args.comms and os.path.exists(args.comms):
+            reports.extend(comms_fixture_reports(args.comms))
+        else:
+            paths = None
+            if args.comms:
+                if args.comms not in _STEP_PATHS:
+                    print(f"unknown step path {args.comms!r}; available: "
+                          f"{list(_STEP_PATHS)} (or pass a fixture PATH)",
+                          file=sys.stderr)
+                    return 2
+                paths = (args.comms,)
+            surfaces = step_comms_surfaces(paths=paths)
+            tables.append(render_comms_table(surfaces))
+            reports.append(analyze_step_comms(paths=paths,
+                                              _surfaces=surfaces))
+    elif args.hbm is not None:
         import os
 
         from .hbm import (analyze_hbm_plan, hbm_fixture_reports, smoke_plan)
@@ -203,6 +248,7 @@ def main(argv=None) -> int:
             # ... and audits the suppressions themselves: a builtin entry
             # that matched nothing across the whole run is a stale WARN
             from .core import Report
+            from .comms import BUILTIN_COMMS_ALLOWLIST
             from .compilesurface import BUILTIN_SURFACE_ALLOWLIST
             from .findings import (BUILTIN_ALLOWLIST,
                                    stale_allowlist_findings)
@@ -214,6 +260,7 @@ def main(argv=None) -> int:
                 ("thread", BUILTIN_THREAD_ALLOWLIST),
                 ("surface", BUILTIN_SURFACE_ALLOWLIST),
                 ("hbm", BUILTIN_HBM_ALLOWLIST),
+                ("comms", BUILTIN_COMMS_ALLOWLIST),
             ])
             reports.append(Report("allowlist.audit", stale, [],
                                   ("allowlist-stale",)))

@@ -510,6 +510,12 @@ class DeploymentPlan:
     programs: tuple = ()                 # ProgramEstimate per manifest entry
     temps_bytes: int = 0                 # declared floor when no programs
     adapter_bank_bytes: int = 0          # ISSUE-15: resident LoRA banks
+    # ISSUE-20: the interconnect component (comms.CommsBudget or None).
+    # DISJOINT from components() by construction: these are bytes MOVED
+    # per tick, not bytes resident, so they never enter the residency sum
+    # (which tests pin as == sum(components)) — they get their own rows in
+    # render_table and their own rule (comms-over-budget).
+    comms: object = None
 
     def __post_init__(self):
         if self.budget_bytes <= 0:
@@ -583,6 +589,7 @@ class DeploymentPlan:
             "programs": [p.to_json() for p in self.programs],
             "temps_bytes": int(self.temps_bytes),
             "adapter_bank_bytes": int(self.adapter_bank_bytes),
+            "comms": self.comms.to_json() if self.comms else None,
             "components": self.components(),
             "planned_total_bytes": self.planned_total_bytes,
         }
@@ -601,6 +608,10 @@ class DeploymentPlan:
         kw["config"] = ServingConfig.from_json(kw["config"])
         kw["programs"] = tuple(ProgramEstimate.from_json(p)
                                for p in kw.get("programs", ()))
+        if kw.get("comms") is not None:
+            from .comms import CommsBudget
+
+            kw["comms"] = CommsBudget.from_json(kw["comms"])
         return cls(**kw)
 
     def render_table(self) -> str:
@@ -621,6 +632,15 @@ class DeploymentPlan:
                          f"{pct:5.1f}% of budget")
         lines.append(f"  {'total':12s} {fmt_bytes(total):>12s}  "
                      f"{100.0 * total / self.budget_bytes:5.1f}% -> {fit}")
+        if self.comms is not None:
+            share = self.comms.share_of_tick()
+            wall_ms = self.comms.tick_wall_s * 1e3
+            lines.append(
+                f"  {'comms':12s} {fmt_bytes(self.comms.bytes_per_tick):>12s}"
+                + ("  on wire/tick, interconnect unknown (un-gated)"
+                   if share is None else
+                   f"  on wire/tick = {share:6.1%} of the {wall_ms:.0f}ms "
+                   "tick wall"))
         for p in self.programs:
             measured = (fmt_bytes(p.measured_peak_bytes)
                         if p.measured_peak_bytes else "n/a")
@@ -732,6 +752,15 @@ def analyze_hbm_plan(plan, *, strict=False, allowlist=None,
     findings.extend(_rule_estimate_drift(plan))
     findings.extend(_rule_oversized_temp(plan, strict=strict))
     findings.extend(_rule_pool_misfit(plan, strict=strict))
+    rules = tuple(HBM_RULES)
+    if plan.comms is not None:
+        # ISSUE-20: a plan that carries its interconnect component gets the
+        # comms budget gate too — the deploy review reads ONE table
+        from .comms import _rule_comms_over_budget
+
+        findings.extend(_rule_comms_over_budget(
+            plan.comms, subject=f"{plan.config.name}:comms"))
+        rules += ("comms-over-budget",)
     al = allowlist if allowlist is not None else BUILTIN_HBM_ALLOWLIST
     try:
         backend = jax.default_backend()
@@ -739,7 +768,7 @@ def analyze_hbm_plan(plan, *, strict=False, allowlist=None,
         backend = ""
     kept, suppressed = al.apply(findings, backend)
     return Report(name or f"hbm.residency[{plan.config.name}]", kept,
-                  suppressed, tuple(HBM_RULES))
+                  suppressed, rules)
 
 
 # ============================================================= runtime half

@@ -38,6 +38,14 @@ One place builds the programs the CLI ``--self-check``, the bench
   cache-key set of the shipped serving configs must be closed and covered
   by the default manifest, and every key-site path must map to a zoo
   family in this registry (zoo_cross_check).
+* ``comms_surface`` — the ISSUE-20 sharding-and-collective contract
+  (analysis/comms.py): compile the three continuous step programs under
+  the tp=2 serving mesh, inventory every collective GSPMD inserted into
+  the optimized HLO, check the compiled parameter/output shardings
+  against ``SpecLayout.step_contract()``, and size per-tick wire bytes
+  against the chip's ICI. A HIGH here means a mid-program reshard
+  appeared (or an old one changed shape), the layout contract rotted, or
+  the decode tick no longer fits on the wire.
 * ``hbm_residency`` — the ISSUE-14 memory contract (analysis/hbm.py): the
   default continuous ServingConfig's per-chip residency (params + smoke
   KV pool + static temp peaks of its step programs) against the smoke HBM
@@ -557,6 +565,21 @@ def hbm_residency_report(thresholds=None, allowlist=None):
     return analyze_hbm_residency(allowlist=allowlist, name="hbm.residency")
 
 
+def comms_surface_report(thresholds=None, allowlist=None):
+    """The sharding-and-collective contract (ISSUE-20): compile the three
+    continuous step programs under the serving mesh (tp=2 where the host
+    exposes >=2 devices), parse the post-SPMD optimized HLO for every
+    collective GSPMD inserted, and run the five comms rules — implicit
+    reshards, layout-contract drift, replicated large buffers, dead mesh
+    axes, and the per-tick interconnect budget. Graph-lint ``thresholds``
+    do not apply to HLO-text analysis; the parameter exists for registry
+    uniformity."""
+    del thresholds
+    from .comms import analyze_step_comms
+
+    return analyze_step_comms(allowlist=allowlist, name="comms.surface")
+
+
 ZOO_PROGRAMS = {
     "gpt_train": gpt_train_report,
     "resnet_train": resnet_train_report,
@@ -574,6 +597,7 @@ ZOO_PROGRAMS = {
     "gpt_verify_step_lora": gpt_verify_step_lora_report,
     "compile_surface": compile_surface_report,
     "hbm_residency": hbm_residency_report,
+    "comms_surface": comms_surface_report,
 }
 
 

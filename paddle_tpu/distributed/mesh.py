@@ -328,6 +328,58 @@ class SpecLayout:
     def replicated(self, ndim):
         return (None,) * ndim
 
+    # ------------------------------------------------- declared contracts
+    # The two halves of the static comms gate (analysis/comms.py, ISSUE-20)
+    # live HERE because this class is the layout's single declaration
+    # point: the lint compares what XLA actually compiled against what
+    # this file says, so drift between them is a finding, not a shrug.
+
+    def step_contract(self) -> dict:
+        """The declared input-layout contract of the serving step programs:
+        glob over flattened argument labels (``state.<param>``,
+        ``k_pages.<layer>``, ...) -> partition entries. Only labels every
+        step path carries appear — a glob that matches nothing in a
+        compiled program is itself ``layout-contract-drift``."""
+        tp = self.tp_axis
+        return {
+            # Megatron column shards: qkv + fused gate_up split the output
+            # dim; their row-parallel partners split the input dim and own
+            # the partial-sum all-reduce.
+            "state.*qkv_proj.weight": (None, tp),
+            "state.*gate_up.weight": (None, tp),
+            "state.*out_proj.weight": (tp, None),
+            "state.*down.weight": (tp, None),
+            # VocabParallelEmbedding row shard — doubles as the tied
+            # lm_head, which is what makes the logits vocab-sharded.
+            "state.embed_tokens.weight": (tp, None),
+            "state.*ln*.weight": (),
+            # the paged pool head-shards on its leading axis (kv_pool())
+            "k_pages*": (tp,),
+            "v_pages*": (tp,),
+            # host-side knobs stay replicated: sampler params, block
+            # tables and the PRNG key are scheduler state, never sharded
+            "tables": (),
+            "temperatures": (),
+            "top_ks": (),
+            "rng_key": (),
+        }
+
+    def expected_collectives(self) -> dict:
+        """Collective kinds the declared layout transitions explain, with
+        their reasons — the ``implicit-reshard`` whitelist. Anything the
+        compiled step programs emit beyond these kinds is cross-chip
+        traffic nobody declared."""
+        return {
+            "all-reduce":
+                "row-parallel / vocab-parallel partial sums (out_proj, "
+                "down, embedding lookup) and vocab-sharded sampling "
+                "reductions",
+            "all-gather":
+                "the sampled-logits gather: vocab-sharded [slots, V] "
+                "logits reduced per shard, gathered to pick the token "
+                "(the split-KV decode path's one documented exchange)",
+        }
+
 
 def serving_mesh(dp=1, tp=1, *, set_global=True) -> ProcessMesh:
     """Build (and by default install as the global mesh) the ("dp","tp")

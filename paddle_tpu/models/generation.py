@@ -47,6 +47,33 @@ from ..tensor import Tensor
 _TRACE_LOCK = make_lock("generation._TRACE_LOCK")
 
 
+# Canonical flattened-argument labels of the three continuous-scheduler
+# step programs, in call order — the single naming the zoo lint entries,
+# the comms pass (analysis/comms.py) and SpecLayout.step_contract() share,
+# so a signature change breaks ONE table instead of silently desyncing
+# three. The LoRA variants insert ("adapter_slots", "bank") before
+# "rng_key" (step_arg_labels(adapters=True)).
+STEP_ARG_LABELS = {
+    "prefill_chunk": ("state", "chunk", "offsets", "chunk_lens", "tables",
+                      "temperatures", "top_ks", "k_pages", "v_pages",
+                      "rng_key"),
+    "decode_step": ("state", "tokens", "lengths", "active", "max_lens",
+                    "tables", "temperatures", "top_ks", "k_pages",
+                    "v_pages", "rng_key"),
+    "verify_step": ("state", "chunk", "offsets", "draft_lens", "active",
+                    "max_lens", "tables", "temperatures", "top_ks",
+                    "k_pages", "v_pages", "rng_key"),
+}
+
+
+def step_arg_labels(kind, *, adapters=False):
+    """Argument labels for one step program path (see STEP_ARG_LABELS)."""
+    base = STEP_ARG_LABELS[kind]
+    if not adapters:
+        return base
+    return base[:-1] + ("adapter_slots", "bank", "rng_key")
+
+
 def bucket_new_tokens(max_new_tokens):
     """The dense decode path's DECLARED max_new_tokens bucket set: the next
     power of two. The cache key used to carry the raw per-request budget, so
@@ -1000,3 +1027,20 @@ class GenerationMixin:
                     and k[-1] == adapter_signature):
                 return run
         return None
+
+    def compiled_step_program(self, kind, slots, width, args,
+                              adapter_signature=None):
+        """Lower + compile the cached step runner for `kind` (one of
+        STEP_ARG_LABELS) at `args` and return the jax Compiled artifact,
+        or None when the runner is not cached. This is the comms lint's
+        window into the POST-SPMD program: `.as_text()` carries every
+        collective GSPMD inserted and `input_shardings` the layouts it
+        actually chose — neither exists on the traced/lowered forms."""
+        runner = {
+            "prefill_chunk": self.compiled_prefill_chunk_runner,
+            "decode_step": self.compiled_decode_step_runner,
+            "verify_step": self.compiled_verify_step_runner,
+        }[kind](slots, width, adapter_signature)
+        if runner is None:
+            return None
+        return runner.lower(*args).compile()

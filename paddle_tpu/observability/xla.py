@@ -27,7 +27,8 @@ must not be able to take down the training loop it watches.
 from __future__ import annotations
 
 __all__ = ["cost_analysis", "cost_flops", "memory_stats",
-           "device_peak_flops", "PEAK_BF16_FLOPS"]
+           "device_peak_flops", "PEAK_BF16_FLOPS",
+           "device_ici_bandwidth", "ICI_BANDWIDTH_BYTES"]
 
 # Per-chip peak bf16 TFLOP/s (dense), from public TPU specs. The single
 # source of truth — bench.py's _chip_peak reads this table.
@@ -50,6 +51,34 @@ def device_peak_flops(device) -> float | None:
     for name, peak in PEAK_BF16_FLOPS.items():
         if kind.startswith(name):
             return peak
+    return None
+
+
+# Per-chip aggregate ICI bandwidth in BYTES/s (public TPU specs: v3
+# 6x112 Gbps/link ≈ 656 Gbps, v4 2400 Gbps, v5e 1600 Gbps, v5p 4800 Gbps,
+# v6e/Trillium 3584 Gbps — bits on the spec sheet, bytes here). The
+# bandwidth sibling of PEAK_BF16_FLOPS: the comms lint's comms-over-budget
+# rule (analysis/comms.py) divides per-tick wire bytes by this.
+ICI_BANDWIDTH_BYTES = {
+    "TPU v3": 656e9 / 8,
+    "TPU v4": 2400e9 / 8,
+    "TPU v5 lite": 1600e9 / 8,
+    "TPU v5e": 1600e9 / 8,
+    "TPU v5p": 4800e9 / 8,
+    "TPU v5": 4800e9 / 8,
+    "TPU v6 lite": 3584e9 / 8,
+    "TPU v6e": 3584e9 / 8,
+}
+
+
+def device_ici_bandwidth(device) -> float | None:
+    """Per-chip ICI bandwidth of `device` in bytes/s, or None when unknown
+    (CPU, new chip revisions): the comms budget gate runs only when the
+    denominator is real, same contract as device_peak_flops."""
+    kind = getattr(device, "device_kind", "") or ""
+    for name, bw in ICI_BANDWIDTH_BYTES.items():
+        if kind.startswith(name):
+            return bw
     return None
 
 

@@ -140,7 +140,8 @@ def test_zoo_cross_check_and_registry_cover_every_path():
     assert set(fam) == {"dense", "paged", "prefill_chunk", "decode_step",
                         "verify_step"}
     assert "compile_surface" in ZOO_PROGRAMS
-    assert len(ZOO_PROGRAMS) == 16
+    assert "comms_surface" in ZOO_PROGRAMS
+    assert len(ZOO_PROGRAMS) == 17
 
 
 def test_shared_aval_fingerprint_backs_both_sentinels():

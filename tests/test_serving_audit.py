@@ -427,6 +427,50 @@ def test_hbm_planning_bench_wires_plan_and_fields():
     assert "planned_total_bytes" in src
 
 
+# ---------------------------------------------------- comms_lint (ISSUE-20)
+def test_comms_lint_fields_clean():
+    out = {
+        "findings": [{"rule": "dead-mesh-axis", "severity": "warn"}],
+        "comms_share_of_tick": None,     # unknown ICI (CPU) stays None
+    }
+    bench.comms_lint_fields(out)
+    assert out["findings_by_rule"] == {"dead-mesh-axis": 1}
+    assert out["high_total"] == 0
+    assert out["audit"] == "ok"                 # warns alone do not gate
+    assert out["comms_share_of_tick"] is None   # not coerced to a number
+
+
+def test_comms_lint_fields_flag_high():
+    out = {
+        "findings": [{"rule": "implicit-reshard", "severity": "high"},
+                     {"rule": "comms-over-budget", "severity": "high"},
+                     {"rule": "replicated-large-buffer", "severity": "warn"}],
+    }
+    bench.comms_lint_fields(out)
+    assert out["findings_by_rule"] == {"implicit-reshard": 1,
+                                       "comms-over-budget": 1,
+                                       "replicated-large-buffer": 1}
+    assert out["high_total"] == 2
+    assert out["audit"] == "lint-high"
+
+
+def test_comms_lint_bench_wires_surfaces_and_fields():
+    """Source-level pin: bench_comms_lint must compile the step surfaces
+    once (shared with the printed table), run the five-rule pass, size the
+    tick budget, and route through comms_lint_fields — running the full
+    leg is three tp=2 compiles, too heavy for this unit file. main() must
+    carry the section under the "comms_lint" key."""
+    import inspect
+
+    src = inspect.getsource(bench.bench_comms_lint)
+    assert "step_comms_surfaces(" in src
+    assert "analyze_step_comms(_surfaces=surfaces)" in src
+    assert "smoke_comms_budget(" in src
+    assert "comms_lint_fields(" in src
+    assert "bytes_per_decode_launch" in src
+    assert '"comms_lint"' in inspect.getsource(bench.main)
+
+
 # ------------------------------------------------------------ ISSUE-15 lora
 def test_multi_lora_fields_speedup_gate_and_audit():
     """ISSUE-15 acceptance wiring: the multi_lora section derives
